@@ -1,0 +1,17 @@
+"""boojum_tpu — a TPU-native PLONKish + FRI proof system over the Goldilocks field.
+
+A ground-up JAX/XLA/Pallas implementation with the capabilities of Boojum
+(zkSync Era's prover, see /root/reference): PLONKish arithmetization with copy
+constraints, log-derivative lookups, FRI commitment, gate/gadget libraries and
+recursion — designed TPU-first: trace columns are device arrays, the hot path
+(NTT/LDE, Poseidon2 Merkle trees, gate-evaluation sweeps, FRI folds) is
+batched/vmapped XLA, and multi-chip scaling shards trace columns over an ICI
+mesh with XLA collectives.
+"""
+
+import jax
+
+# The whole framework computes over GF(2^64 - 2^32 + 1); we need 64-bit ints.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

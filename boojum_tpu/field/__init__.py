@@ -1,0 +1,21 @@
+from .goldilocks import (
+    P_INT as P,  # python int: safe for user arithmetic (no numpy overflow)
+    EPSILON,
+    MULTIPLICATIVE_GENERATOR,
+    TWO_ADICITY,
+    RADIX_2_SUBGROUP_GENERATOR,
+    add,
+    sub,
+    neg,
+    mul,
+    double,
+    sqr,
+    pow_const,
+    inv,
+    batch_inverse,
+    to_field,
+    mul_wide,
+    reduce128,
+)
+from . import gl
+from . import extension as ext

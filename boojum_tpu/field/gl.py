@@ -1,0 +1,73 @@
+"""Host-side scalar Goldilocks arithmetic over python ints.
+
+Used by synthesis-time code paths that are inherently sequential and tiny
+(transcript, verifier, witness closures, twiddle precomputation) — the
+counterpart of the reference's scalar `GoldilocksField` impl
+(`/root/reference/src/field/goldilocks/mod.rs:290`). Device-scale math lives in
+`goldilocks.py`.
+"""
+
+P = 0xFFFFFFFF00000001
+EPSILON = 0xFFFFFFFF
+MULTIPLICATIVE_GENERATOR = 7
+RADIX_2_SUBGROUP_GENERATOR = 0x185629DCDA58878C
+TWO_ADICITY = 32
+
+
+def add(a: int, b: int) -> int:
+    s = a + b
+    return s - P if s >= P else s
+
+
+def sub(a: int, b: int) -> int:
+    d = a - b
+    return d + P if d < 0 else d
+
+
+def neg(a: int) -> int:
+    return 0 if a == 0 else P - a
+
+
+def mul(a: int, b: int) -> int:
+    return (a * b) % P
+
+
+def sqr(a: int) -> int:
+    return (a * a) % P
+
+
+def pow_(a: int, e: int) -> int:
+    return pow(a, e, P)
+
+
+def inv(a: int) -> int:
+    if a == 0:
+        raise ZeroDivisionError("inverse of zero in GF(p)")
+    return pow(a, P - 2, P)
+
+
+def exp_power_of_2(a: int, k: int) -> int:
+    for _ in range(k):
+        a = sqr(a)
+    return a
+
+
+def omega(log_n: int) -> int:
+    """Primitive 2^log_n-th root of unity (two-adic tower)."""
+    assert log_n <= TWO_ADICITY
+    return exp_power_of_2(RADIX_2_SUBGROUP_GENERATOR, TWO_ADICITY - log_n)
+
+
+def powers(base: int, count: int) -> list:
+    out = [1] * count
+    for i in range(1, count):
+        out[i] = mul(out[i - 1], base)
+    return out
+
+
+def from_u64_with_reduction(x: int) -> int:
+    return x % P
+
+
+def as_bits_le(x: int, num_bits: int = 64) -> list:
+    return [(x >> i) & 1 for i in range(num_bits)]

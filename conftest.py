@@ -1,0 +1,33 @@
+"""Repo-root pytest conftest.
+
+Forces tests onto a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware, and makes `boojum_tpu` importable. Must run
+before anything imports jax.
+"""
+
+import os
+import sys
+
+# Force CPU: the ambient environment pins JAX_PLATFORMS=axon (remote TPU
+# tunnel), which is for bench runs, not unit tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# The axon sitecustomize (PYTHONPATH) registers a remote-TPU PJRT plugin whose
+# backend init blocks even under JAX_PLATFORMS=cpu; deregister it outright so
+# unit tests run on the local 8-device virtual CPU platform.
+try:
+    import jax
+    from jax._src import xla_bridge
+
+    jax.config.update("jax_platforms", "cpu")
+    xla_bridge._backend_factories.pop("axon", None)
+except Exception:
+    pass
+

@@ -1,0 +1,128 @@
+"""Field-layer parity tests vs python-int ground truth.
+
+Mirrors the reference's field test strategy
+(/root/reference/src/field/traits/field.rs:546 axioms,
+ src/field/goldilocks/generic_impl.rs vector-op checks).
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from boojum_tpu.field import gl
+from boojum_tpu.field import goldilocks as gf
+from boojum_tpu.field import extension as ext
+
+P = gl.P
+rng = random.Random(1234)
+
+
+def rand_vec(n, special_frac=0.25):
+    """Random canonical elements, salted with boundary cases."""
+    specials = [0, 1, P - 1, P - 2, 0xFFFFFFFF, 0x100000000, P // 2, 2**63]
+    out = []
+    for _ in range(n):
+        if rng.random() < special_frac:
+            out.append(rng.choice(specials))
+        else:
+            out.append(rng.randrange(P))
+    return out
+
+
+def as_arr(xs):
+    return jnp.asarray(np.array(xs, dtype=np.uint64))
+
+
+N = 4096
+
+
+def test_add_sub_neg_parity():
+    a, b = rand_vec(N), rand_vec(N)
+    aa, bb = as_arr(a), as_arr(b)
+    assert list(np.asarray(gf.add(aa, bb))) == [gl.add(x, y) for x, y in zip(a, b)]
+    assert list(np.asarray(gf.sub(aa, bb))) == [gl.sub(x, y) for x, y in zip(a, b)]
+    assert list(np.asarray(gf.neg(aa))) == [gl.neg(x) for x in a]
+
+
+def test_mul_parity():
+    a, b = rand_vec(N), rand_vec(N)
+    aa, bb = as_arr(a), as_arr(b)
+    assert list(np.asarray(gf.mul(aa, bb))) == [gl.mul(x, y) for x, y in zip(a, b)]
+    assert list(np.asarray(gf.sqr(aa))) == [gl.sqr(x) for x in a]
+
+
+def test_mul_small_and_pow():
+    a = rand_vec(256)
+    aa = as_arr(a)
+    for k in [0, 1, 2, 3, 7, 11, 255]:
+        assert list(np.asarray(gf.mul_small(aa, k))) == [gl.mul(x, k) for x in a]
+    for e in [0, 1, 2, 5, 97, P - 2]:
+        assert list(np.asarray(gf.pow_const(aa, e))) == [gl.pow_(x, e) for x in a]
+
+
+def test_inverse():
+    a = [x if x != 0 else 1 for x in rand_vec(512)]
+    aa = as_arr(a)
+    got = np.asarray(gf.inv(aa))
+    for x, y in zip(a, got):
+        assert gl.mul(x, int(y)) == 1
+
+
+def test_batch_inverse():
+    a = [x if x != 0 else 1 for x in rand_vec(1024)]
+    aa = as_arr(a)
+    got = np.asarray(gf.batch_inverse(aa))
+    for x, y in zip(a, got):
+        assert gl.mul(x, int(y)) == 1
+    # 2-D shape: batches along last axis
+    m = as_arr(a).reshape(4, 256)
+    got2 = np.asarray(gf.batch_inverse(m)).reshape(-1)
+    assert list(got2) == list(got)
+
+
+def test_two_adic_generator():
+    # RADIX_2_SUBGROUP_GENERATOR has order exactly 2^32
+    g = gl.RADIX_2_SUBGROUP_GENERATOR
+    assert gl.exp_power_of_2(g, 32) == 1
+    assert gl.exp_power_of_2(g, 31) == P - 1
+    w = gl.omega(4)
+    assert gl.pow_(w, 16) == 1 and gl.pow_(w, 8) != 1
+
+
+def test_extension_axioms_host():
+    for _ in range(200):
+        a = (rng.randrange(P), rng.randrange(P))
+        b = (rng.randrange(P), rng.randrange(P))
+        c = (rng.randrange(P), rng.randrange(P))
+        # distributivity
+        lhs = ext.mul_s(a, ext.add_s(b, c))
+        rhs = ext.add_s(ext.mul_s(a, b), ext.mul_s(a, c))
+        assert lhs == rhs
+        # inverse
+        if a != (0, 0):
+            assert ext.mul_s(a, ext.inv_s(a)) == (1, 0)
+
+
+def test_extension_device_matches_host():
+    n = 512
+    a0, a1 = rand_vec(n), rand_vec(n)
+    b0, b1 = rand_vec(n), rand_vec(n)
+    aa = (as_arr(a0), as_arr(a1))
+    bb = (as_arr(b0), as_arr(b1))
+    got = ext.mul(aa, bb)
+    want = [ext.mul_s((x0, x1), (y0, y1)) for x0, x1, y0, y1 in zip(a0, a1, b0, b1)]
+    assert list(np.asarray(got[0])) == [w[0] for w in want]
+    assert list(np.asarray(got[1])) == [w[1] for w in want]
+    # device ext inverse
+    nz = [(x if (x, y) != (0, 0) else 1, y) for x, y in zip(a0, a1)]
+    aa_nz = (as_arr([v[0] for v in nz]), as_arr([v[1] for v in nz]))
+    ii = ext.inv(aa_nz)
+    for i in range(n):
+        got_i = (int(np.asarray(ii[0])[i]), int(np.asarray(ii[1])[i]))
+        assert ext.mul_s(nz[i], got_i) == (1, 0)
+
+
+def test_to_field():
+    arr = gf.to_field([0, 1, P, P + 5, -1])
+    assert list(np.asarray(arr)) == [0, 1, 0, 5, P - 1]
