@@ -130,7 +130,8 @@ def mul_small(a: jax.Array, k: int) -> jax.Array:
 
 def pow_const(a: jax.Array, e: int) -> jax.Array:
     """a ** e for a python-int exponent (static square-and-multiply chain)."""
-    e = int(e) % (P_INT - 1) if e >= P_INT - 1 else int(e)
+    e = int(e)
+    assert e >= 0
     result = None
     base = a
     while e:

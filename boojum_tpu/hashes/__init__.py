@@ -1,0 +1,8 @@
+from . import poseidon2_params
+from .poseidon2 import (
+    poseidon2_permutation,
+    poseidon2_permutation_host,
+    leaf_hash,
+    node_hash,
+    Poseidon2SpongeHost,
+)

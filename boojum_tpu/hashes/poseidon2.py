@@ -1,0 +1,219 @@
+"""Poseidon2 permutation (Goldilocks, t=12, x^7) — batched device + host scalar.
+
+Algorithm per the Poseidon2 paper (eprint 2023/323), parameter-compatible with
+the reference implementation (`/root/reference/src/implementations/poseidon2/
+state_generic_impl.rs:222` poseidon2_permutation: pre-multiply by the external
+matrix, 4 full rounds, 22 partial rounds with the internal matrix, 4 full
+rounds). The external matrix is circ(2·M4, M4, M4); we evaluate it with the
+shift-free add/double chain so the whole permutation is VPU-friendly modular
+adds + the x^7 sbox muls, batched over an arbitrary leading leaf axis.
+
+Sponge semantics (rate 8 / capacity 4, overwrite mode) follow
+`/root/reference/src/algebraic_props/sponge.rs` so leaf/node/transcript hashing
+is bit-compatible with the reference tree hasher.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import goldilocks as gf
+from . import poseidon2_params as params
+
+_RC = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
+_DIAG = np.array(params.M_I_DIAGONAL, dtype=np.uint64)
+
+
+def _sbox7(x):
+    x2 = gf.sqr(x)
+    x3 = gf.mul(x2, x)
+    x4 = gf.sqr(x2)
+    return gf.mul(x4, x3)
+
+
+def _block_m4(x0, x1, x2, x3):
+    """M4 = [[5,7,1,3],[4,6,1,1],[1,3,5,7],[1,1,4,6]] via add/double chain."""
+    t0 = gf.add(x0, x1)
+    t1 = gf.add(x2, x3)
+    t2 = gf.add(gf.double(x1), t1)
+    t3 = gf.add(gf.double(x3), t0)
+    t4 = gf.add(gf.double(gf.double(t1)), t3)
+    t5 = gf.add(gf.double(gf.double(t0)), t2)
+    t6 = gf.add(t3, t5)
+    t7 = gf.add(t2, t4)
+    return t6, t5, t7, t4
+
+
+def _external_mds(state):
+    """state (..., 12) -> circ(2*M4, M4, M4) · state."""
+    cols = [state[..., i] for i in range(12)]
+    blocks = []
+    for b in range(3):
+        blocks.append(_block_m4(*cols[4 * b : 4 * b + 4]))
+    out = []
+    for i in range(4):
+        s = gf.add(gf.add(blocks[0][i], blocks[1][i]), blocks[2][i])
+        out.append(s)
+    new_cols = []
+    for b in range(3):
+        for i in range(4):
+            new_cols.append(gf.add(blocks[b][i], out[i]))
+    return jnp.stack(new_cols, axis=-1)
+
+
+def _internal_mds(state):
+    """M_I = all-ones + diag(d): out_i = d_i·x_i + sum_j x_j."""
+    total = state[..., 0]
+    for i in range(1, 12):
+        total = gf.add(total, state[..., i])
+    scaled = gf.mul(state, jnp.asarray(_DIAG))
+    return gf.add(scaled, total[..., None])
+
+
+def poseidon2_permutation(state: jax.Array) -> jax.Array:
+    """Batched Poseidon2 permutation on (..., 12) uint64 arrays."""
+    rc = jnp.asarray(_RC)
+    state = _external_mds(state)
+    for r in range(4):
+        state = gf.add(state, rc[r])
+        state = _sbox7(state)
+        state = _external_mds(state)
+    for r in range(4, 26):
+        el0 = gf.add(state[..., 0], rc[r, 0])
+        el0 = _sbox7(el0)
+        state = jnp.concatenate([el0[..., None], state[..., 1:]], axis=-1)
+        state = _internal_mds(state)
+    for r in range(26, 30):
+        state = gf.add(state, rc[r])
+        state = _sbox7(state)
+        state = _external_mds(state)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Device sponge helpers (rate 8, cap 4, overwrite mode)
+# ---------------------------------------------------------------------------
+
+
+def leaf_hash(values: jax.Array) -> jax.Array:
+    """Hash (..., L) field values into (..., 4) leaf digests.
+
+    Overwrite-mode sponge: each full 8-chunk overwrites the rate portion then
+    permutes; a trailing partial chunk is zero-padded (finalize semantics of
+    the reference sponge).
+    """
+    lead = values.shape[:-1]
+    L = values.shape[-1]
+    state = jnp.zeros(lead + (12,), jnp.uint64)
+    full = L // 8
+    for c in range(full):
+        chunk = values[..., 8 * c : 8 * c + 8]
+        state = jnp.concatenate([chunk, state[..., 8:]], axis=-1)
+        state = poseidon2_permutation(state)
+    rem = L - 8 * full
+    if rem > 0:
+        chunk = values[..., 8 * full :]
+        pad = jnp.zeros(lead + (8 - rem,), jnp.uint64)
+        state = jnp.concatenate([chunk, pad, state[..., 8:]], axis=-1)
+        state = poseidon2_permutation(state)
+    return state[..., :4]
+
+
+def node_hash(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Hash two (..., 4) digests into a (..., 4) parent digest."""
+    state = jnp.concatenate(
+        [left, right, jnp.zeros(left.shape[:-1] + (4,), jnp.uint64)], axis=-1
+    )
+    return poseidon2_permutation(state)[..., :4]
+
+
+# ---------------------------------------------------------------------------
+# Host scalar mirror (python ints) — transcript & proof verification
+# ---------------------------------------------------------------------------
+
+
+def _sbox7_s(x):
+    x2 = gl.sqr(x)
+    x3 = gl.mul(x2, x)
+    return gl.mul(gl.sqr(x2), x3)
+
+
+def _block_m4_s(x0, x1, x2, x3):
+    t0 = gl.add(x0, x1)
+    t1 = gl.add(x2, x3)
+    t2 = gl.add(gl.add(x1, x1), t1)
+    t3 = gl.add(gl.add(x3, x3), t0)
+    t4 = gl.add(gl.add(gl.add(t1, t1), gl.add(t1, t1)), t3)
+    t5 = gl.add(gl.add(gl.add(t0, t0), gl.add(t0, t0)), t2)
+    return gl.add(t3, t5), t5, gl.add(t2, t4), t4
+
+
+def _external_mds_s(s):
+    blocks = [_block_m4_s(*s[4 * b : 4 * b + 4]) for b in range(3)]
+    sums = [
+        gl.add(gl.add(blocks[0][i], blocks[1][i]), blocks[2][i]) for i in range(4)
+    ]
+    return [gl.add(blocks[b][i], sums[i]) for b in range(3) for i in range(4)]
+
+
+def _internal_mds_s(s):
+    total = 0
+    for v in s:
+        total = gl.add(total, v)
+    return [gl.add(gl.mul(s[i], params.M_I_DIAGONAL[i]), total) for i in range(12)]
+
+
+def poseidon2_permutation_host(state: list) -> list:
+    s = _external_mds_s(list(state))
+    for r in range(4):
+        s = [gl.add(v, int(_RC[r, i])) for i, v in enumerate(s)]
+        s = [_sbox7_s(v) for v in s]
+        s = _external_mds_s(s)
+    for r in range(4, 26):
+        s[0] = _sbox7_s(gl.add(s[0], int(_RC[r, 0])))
+        s = _internal_mds_s(s)
+    for r in range(26, 30):
+        s = [gl.add(v, int(_RC[r, i])) for i, v in enumerate(s)]
+        s = [_sbox7_s(v) for v in s]
+        s = _external_mds_s(s)
+    return s
+
+
+class Poseidon2SpongeHost:
+    """Overwrite-mode sponge over python ints (transcripts, path verification)."""
+
+    RATE = 8
+    CAPACITY = 4
+
+    def __init__(self):
+        self.state = [0] * 12
+        self.buffer = []
+
+    def absorb(self, values):
+        self.buffer.extend(int(v) for v in values)
+        while len(self.buffer) >= 8:
+            chunk, self.buffer = self.buffer[:8], self.buffer[8:]
+            self.state[:8] = chunk
+            self.state = poseidon2_permutation_host(self.state)
+
+    def finalize(self, n=4):
+        if self.buffer:
+            self.state[: len(self.buffer)] = self.buffer
+            for i in range(len(self.buffer), 8):
+                self.state[i] = 0
+            self.state = poseidon2_permutation_host(self.state)
+            self.buffer = []
+        return self.state[:n]
+
+    @staticmethod
+    def hash_leaf(values, n=4):
+        sp = Poseidon2SpongeHost()
+        sp.absorb(values)
+        return sp.finalize(n)
+
+    @staticmethod
+    def hash_node(left, right):
+        sp = Poseidon2SpongeHost()
+        sp.absorb(list(left) + list(right))
+        return sp.finalize(4)

@@ -1,0 +1,71 @@
+"""Merkle tree with cap over Poseidon2 digests — device construction.
+
+Counterpart of `/root/reference/src/cs/oracle/merkle_tree.rs:17` (construct
+`:78`, get_proof `:462`, verify_proof_over_cap `:482`). Leaves are rows of a
+(num_leaves, leaf_width) device array (all committed columns evaluated at one
+LDE point, in full-domain bit-reversed enumeration); leaf hashing is one
+batched sponge over the whole array, node layers are batched 2-to-1 hashes.
+The cap (top 2^k nodes) replaces the single root. Query-path extraction
+gathers from the stored device layers on host at query time (queries are rare:
+~100 per proof).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .hashes.poseidon2 import leaf_hash, node_hash, Poseidon2SpongeHost
+
+
+class MerkleTreeWithCap:
+    def __init__(self, leaf_values, cap_size: int, num_elems_per_leaf: int = 1):
+        """leaf_values: (num_leaves, leaf_width) uint64 device array.
+
+        num_elems_per_leaf > 1 groups that many adjacent rows into one leaf
+        (used by FRI intermediate oracles, mirroring the reference's
+        `num_elements_per_leaf`); leaf width becomes width*num_elems.
+        """
+        assert cap_size & (cap_size - 1) == 0
+        n = leaf_values.shape[0]
+        if num_elems_per_leaf > 1:
+            leaf_values = leaf_values.reshape(
+                n // num_elems_per_leaf, -1
+            )
+        self.num_leaves = leaf_values.shape[0]
+        assert self.num_leaves & (self.num_leaves - 1) == 0, "leaf count must be 2^k"
+        assert self.num_leaves >= cap_size
+        self.cap_size = cap_size
+        digests = leaf_hash(leaf_values)  # (N, 4)
+        layers = [digests]
+        while layers[-1].shape[0] > cap_size:
+            cur = layers[-1]
+            layers.append(node_hash(cur[0::2], cur[1::2]))
+        self.layers = layers
+        self._cap_host = [tuple(int(x) for x in row) for row in np.asarray(layers[-1])]
+
+    def get_cap(self):
+        return list(self._cap_host)
+
+    def get_proof(self, leaf_idx: int):
+        """Sibling digests from the leaf layer up to (not including) the cap."""
+        path = []
+        idx = leaf_idx
+        for layer in self.layers[:-1]:
+            sib = np.asarray(layer[idx ^ 1])
+            path.append(tuple(int(x) for x in sib))
+            idx >>= 1
+        return path
+
+
+def verify_proof_over_cap(leaf_values, path, cap, leaf_idx: int) -> bool:
+    """Host-side path verification (python ints), reference `:482` semantics."""
+    digest = Poseidon2SpongeHost.hash_leaf([int(v) for v in leaf_values])
+    idx = leaf_idx
+    for sib in path:
+        if idx & 1:
+            digest = Poseidon2SpongeHost.hash_node(sib, digest)
+        else:
+            digest = Poseidon2SpongeHost.hash_node(digest, sib)
+        idx >>= 1
+    return tuple(digest) == tuple(cap[idx])

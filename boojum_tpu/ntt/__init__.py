@@ -1,0 +1,13 @@
+from .ntt import (
+    NTTContext,
+    get_ntt_context,
+    bitreverse_indices,
+    fft_natural_to_bitreversed,
+    ifft_bitreversed_to_natural,
+    ifft_natural_to_natural,
+    powers_device,
+    distribute_powers,
+    lde_from_monomial,
+    monomial_from_values,
+    eval_monomial_at_ext_point,
+)

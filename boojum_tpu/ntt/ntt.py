@@ -1,0 +1,211 @@
+"""Radix-2 NTT / coset LDE over Goldilocks, batched across trace columns.
+
+TPU-native counterpart of the reference FFT layer
+(`/root/reference/src/fft/mod.rs:398` fft_natural_to_bitreversed, `:464`
+ifft_natural_to_natural, `:308` distribute_powers) and the LDE transform family
+(`src/cs/implementations/utils.rs:270`). Instead of 16-lane SIMD butterflies,
+every stage is one whole-array reshape+butterfly expressed in jnp; XLA fuses
+the modular-arithmetic ops and tiles them on the VPU. Columns batch along
+leading axes, so one call transforms the entire witness at once.
+
+Domain conventions (chosen so FRI pairing and Merkle layout are contiguous):
+- forward: natural input -> bit-reversed output (Gentleman-Sande / DIF)
+- inverse: bit-reversed input -> natural output (Cooley-Tukey / DIT)
+- LDE storage: shape (..., lde_factor, n); coset axis is indexed by the
+  BIT-REVERSED coset index, each coset internally bit-reversed. Flattening the
+  last two axes yields the full 2^(a+b) domain {g·w_N^i} in bit-reversed order
+  of i (since brev_N(k·lde + j) = brev(j)·n + brev(k)): FRI fold pairs
+  (x, -x) are then adjacent.
+"""
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import extension as ext
+from ..field import goldilocks as gf
+
+
+def bitreverse_indices(log_n: int) -> np.ndarray:
+    """Permutation perm[i] = bitreverse(i, log_n) as int32 numpy array."""
+    n = 1 << log_n
+    idx = np.arange(n, dtype=np.uint32)
+    rev = np.zeros_like(idx)
+    for b in range(log_n):
+        rev |= ((idx >> b) & 1) << (log_n - 1 - b)
+    return rev.astype(np.int32)
+
+
+def powers_device(base: int, count: int) -> jax.Array:
+    """[1, b, b^2, ..., b^(count-1)] built with log2(count) vector muls."""
+    assert count & (count - 1) == 0, "count must be a power of two"
+    pows = jnp.asarray(np.array([1], dtype=np.uint64))
+    b = base % gl.P
+    cur = 1
+    while cur < count:
+        # pows[cur:2cur] = pows[:cur] * b^cur
+        step = jnp.uint64(pow(b, cur, gl.P))
+        pows = jnp.concatenate([pows, gf.mul(pows, step)])
+        cur *= 2
+    return pows
+
+
+class NTTContext:
+    """Cached twiddle tables for size-2^log_n transforms."""
+
+    def __init__(self, log_n: int):
+        assert 0 < log_n <= gl.TWO_ADICITY
+        self.log_n = log_n
+        self.n = 1 << log_n
+        self.omega = gl.omega(log_n)
+        self.omega_inv = gl.inv(self.omega)
+        self.n_inv = jnp.uint64(gl.inv(self.n))
+        half = max(self.n // 2, 1)
+        self.tw = powers_device(self.omega, half) if self.n > 1 else None
+        self.itw = powers_device(self.omega_inv, half) if self.n > 1 else None
+        self.brev = jnp.asarray(bitreverse_indices(log_n))
+
+
+@lru_cache(maxsize=None)
+def get_ntt_context(log_n: int) -> NTTContext:
+    return NTTContext(log_n)
+
+
+def fft_natural_to_bitreversed(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
+    """DIF NTT along the last axis; output in bit-reversed order."""
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    assert 1 << log_n == n
+    if ctx is None:
+        ctx = get_ntt_context(log_n)
+    lead = a.shape[:-1]
+    for s in range(log_n):
+        block = n >> s
+        half = block >> 1
+        tw = ctx.tw[:: n // block][:half] if half > 1 else ctx.tw[:1]
+        x = a.reshape(lead + (n // block, 2, half))
+        u = x[..., 0, :]
+        v = x[..., 1, :]
+        top = gf.add(u, v)
+        bot = gf.mul(gf.sub(u, v), tw)
+        a = jnp.stack([top, bot], axis=-2).reshape(lead + (n,))
+    return a
+
+
+def ifft_bitreversed_to_natural(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
+    """DIT inverse NTT along the last axis; input bit-reversed, output natural.
+
+    Includes the 1/n scaling.
+    """
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    assert 1 << log_n == n
+    if ctx is None:
+        ctx = get_ntt_context(log_n)
+    lead = a.shape[:-1]
+    for s in range(log_n):
+        block = 2 << s
+        half = block >> 1
+        tw = ctx.itw[:: n // block][:half] if half > 1 else ctx.itw[:1]
+        x = a.reshape(lead + (n // block, 2, half))
+        u = x[..., 0, :]
+        wv = gf.mul(x[..., 1, :], tw)
+        top = gf.add(u, wv)
+        bot = gf.sub(u, wv)
+        a = jnp.stack([top, bot], axis=-2).reshape(lead + (n,))
+    return gf.mul(a, ctx.n_inv)
+
+
+def ifft_natural_to_natural(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
+    """Interpolate monomial coefficients from values over H in natural order."""
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    if ctx is None:
+        ctx = get_ntt_context(log_n)
+    return ifft_bitreversed_to_natural(a[..., ctx.brev], ctx)
+
+
+def distribute_powers(a: jax.Array, base: int) -> jax.Array:
+    """a[..., i] *= base^i (the coset shift before a forward transform)."""
+    n = a.shape[-1]
+    return gf.mul(a, powers_device(base, n))
+
+
+def lde_from_monomial(
+    coeffs: jax.Array,
+    lde_factor: int,
+    coset: int = gl.MULTIPLICATIVE_GENERATOR,
+) -> jax.Array:
+    """Low-degree-extend monomial coeffs (..., n) -> (..., lde_factor, n).
+
+    Coset axis is indexed by bit-reversed coset index; each coset is the
+    bit-reversed evaluations over {coset·w_N^j·<w_n>}. Flattening the last two
+    axes gives the full LDE domain in bit-reversed enumeration.
+    """
+    n = coeffs.shape[-1]
+    log_n = n.bit_length() - 1
+    log_lde = lde_factor.bit_length() - 1
+    assert 1 << log_lde == lde_factor
+    ctx = get_ntt_context(log_n)
+    w_full = gl.omega(log_n + log_lde)
+    brev_lde = bitreverse_indices(log_lde)
+    # scale matrix: (lde, n) of shift_j^i, rows ordered by bit-reversed j
+    shifts = [gl.mul(coset % gl.P, gl.pow_(w_full, int(j))) for j in brev_lde]
+    scale = jnp.stack([powers_device(s, n) for s in shifts])  # (lde, n)
+    scaled = gf.mul(coeffs[..., None, :], scale)  # (..., lde, n)
+    return fft_natural_to_bitreversed(scaled, ctx)
+
+
+def monomial_from_values(values: jax.Array) -> jax.Array:
+    """Values over H (natural order) -> monomial coefficients."""
+    return ifft_natural_to_natural(values)
+
+
+def eval_monomial_at_ext_point(coeffs: jax.Array, z, z_pows=None):
+    """Evaluate base-field monomial polys (..., n) at an extension point z.
+
+    z is a host scalar (c0, c1); returns ext pair of shape (...,). Uses a
+    power table + reduction instead of a sequential Horner chain (the
+    device-friendly analogue of the reference's barycentric evaluation,
+    `/root/reference/src/cs/implementations/utils.rs:1025`).
+    """
+    n = coeffs.shape[-1]
+    if z_pows is None:
+        z_pows = ext_powers_device(z, n)
+    c0 = gf.mul(coeffs, z_pows[0])
+    c1 = gf.mul(coeffs, z_pows[1])
+    # sum over last axis, mod p: reduce via pairwise modular adds
+    return (_modsum(c0), _modsum(c1))
+
+
+def ext_powers_device(z, count: int):
+    """Powers [1, z, ..., z^(count-1)] of an ext scalar, as pair of arrays."""
+    assert count & (count - 1) == 0
+    p0 = jnp.asarray(np.array([1], dtype=np.uint64))
+    p1 = jnp.asarray(np.array([0], dtype=np.uint64))
+    cur = 1
+    zc = (int(z[0]), int(z[1]))
+    while cur < count:
+        step = ext.pow_s(zc, cur)
+        n0, n1 = ext.mul((p0, p1), (jnp.uint64(step[0]), jnp.uint64(step[1])))
+        p0 = jnp.concatenate([p0, n0])
+        p1 = jnp.concatenate([p1, n1])
+        cur *= 2
+    return (p0, p1)
+
+
+def _modsum(a: jax.Array) -> jax.Array:
+    """Modular sum along the last axis via log-depth pairwise folding."""
+    n = a.shape[-1]
+    while n > 1:
+        if n % 2 == 1:
+            a = jnp.concatenate(
+                [a, jnp.zeros(a.shape[:-1] + (1,), a.dtype)], axis=-1
+            )
+            n += 1
+        a = gf.add(a[..., : n // 2], a[..., n // 2 :])
+        n //= 2
+    return a[..., 0]

@@ -1,0 +1,81 @@
+"""Fiat–Shamir transcript over the Poseidon2 sponge (host-side).
+
+Semantics follow the reference's algebraic sponge transcript
+(`/root/reference/src/cs/implementations/transcript.rs:48`
+AlgebraicSpongeBasedTranscript, overwrite absorption, rescue-prime padding
+with a trailing 1) and its query-index bit buffer (`:369` BoolsBuffer). The
+transcript is inherently sequential and tiny, so it runs on host python ints;
+everything it absorbs (caps, evaluations) is read back from device once per
+round.
+"""
+
+from .field import gl
+from .hashes.poseidon2 import poseidon2_permutation_host
+
+
+class Poseidon2Transcript:
+    def __init__(self):
+        self.state = [0] * 12
+        self.buffer = []
+        self.available = []
+
+    def witness_field_elements(self, els):
+        self.buffer.extend(int(e) % gl.P for e in els)
+
+    def witness_merkle_tree_cap(self, cap):
+        for digest in cap:
+            self.witness_field_elements(digest)
+
+    def get_challenge(self) -> int:
+        if not self.buffer:
+            if self.available:
+                return self.available.pop(0)
+            self.state = poseidon2_permutation_host(self.state)
+            self.available = list(self.state[:8])
+            return self.available.pop(0)
+        # rescue-prime padding: trailing 1, then zeros to a multiple of rate
+        to_absorb = self.buffer + [1]
+        self.buffer = []
+        while len(to_absorb) % 8 != 0:
+            to_absorb.append(0)
+        for i in range(0, len(to_absorb), 8):
+            self.state[:8] = to_absorb[i : i + 8]
+            self.state = poseidon2_permutation_host(self.state)
+        self.available = list(self.state[:8])
+        return self.available.pop(0)
+
+    def get_multiple_challenges(self, n: int):
+        return [self.get_challenge() for _ in range(n)]
+
+    def get_ext_challenge(self):
+        c0 = self.get_challenge()
+        c1 = self.get_challenge()
+        return (c0, c1)
+
+
+class BitSource:
+    """Uniform query-index bits drawn from transcript challenges.
+
+    Takes only the low (64 - max_needed) bits of each challenge for
+    uniformity, as the reference does (`transcript.rs:388`).
+    """
+
+    def __init__(self, max_needed_bits: int):
+        assert 0 < max_needed_bits < 64
+        self.bits = []
+        self.max_needed = max_needed_bits
+
+    def get_bits(self, transcript: Poseidon2Transcript, num_bits: int):
+        while len(self.bits) < num_bits:
+            c = transcript.get_challenge()
+            usable = 64 - self.max_needed
+            self.bits.extend((c >> i) & 1 for i in range(usable))
+        out, self.bits = self.bits[:num_bits], self.bits[num_bits:]
+        return out
+
+    def get_index(self, transcript: Poseidon2Transcript, num_bits: int) -> int:
+        bits = self.get_bits(transcript, num_bits)
+        idx = 0
+        for i, b in enumerate(bits):
+            idx |= b << i
+        return idx

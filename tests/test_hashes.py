@@ -1,0 +1,148 @@
+"""Poseidon2 / sponge / Merkle / transcript tests.
+
+Mirrors the reference's hash test layering (state_generic_impl.rs tests,
+merkle_tree.rs construct/verify, transcript determinism).
+"""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from boojum_tpu.field import gl
+from boojum_tpu.hashes import poseidon2_params as params
+from boojum_tpu.hashes.poseidon2 import (
+    Poseidon2SpongeHost,
+    leaf_hash,
+    node_hash,
+    poseidon2_permutation,
+    poseidon2_permutation_host,
+)
+from boojum_tpu.merkle import MerkleTreeWithCap, verify_proof_over_cap
+from boojum_tpu.transcript import BitSource, Poseidon2Transcript
+
+rng = random.Random(7)
+
+
+def test_permutation_device_matches_host():
+    batch = 16
+    states = [[rng.randrange(gl.P) for _ in range(12)] for _ in range(batch)]
+    dev = np.asarray(
+        poseidon2_permutation(jnp.asarray(np.array(states, dtype=np.uint64)))
+    )
+    for i, s in enumerate(states):
+        host = poseidon2_permutation_host(list(s))
+        assert [int(x) for x in dev[i]] == host
+
+
+def test_permutation_properties():
+    # diffusion sanity: single-bit input change flips the whole state
+    s0 = [0] * 12
+    s1 = [1] + [0] * 11
+    o0 = poseidon2_permutation_host(s0)
+    o1 = poseidon2_permutation_host(s1)
+    assert o0 != o1
+    assert all(a != b for a, b in zip(o0, o1))
+    # determinism
+    assert poseidon2_permutation_host(s0) == o0
+
+
+def test_mds_external_linearity():
+    # permutation's external matrix is linear: check via the device
+    # _external_mds through zero-sbox trick is private; test linearity of
+    # full first matrix by comparing host block math against a naive matmul.
+    from boojum_tpu.hashes.poseidon2 import _external_mds_s
+
+    M4 = [[5, 7, 1, 3], [4, 6, 1, 1], [1, 3, 5, 7], [1, 1, 4, 6]]
+    # full 12x12: circ(2*M4, M4, M4)
+    full = [[0] * 12 for _ in range(12)]
+    for bi in range(3):
+        for bj in range(3):
+            mult = 2 if bi == bj else 1
+            for i in range(4):
+                for j in range(4):
+                    full[4 * bi + i][4 * bj + j] = M4[i][j] * mult
+    vec = [rng.randrange(gl.P) for _ in range(12)]
+    want = [
+        sum(gl.mul(full[i][j], vec[j]) for j in range(12)) % gl.P for i in range(12)
+    ]
+    got = _external_mds_s(list(vec))
+    assert got == want
+
+
+def test_sponge_chunking_edges():
+    # leaf widths around the rate boundary must agree device vs host
+    for width in [1, 7, 8, 9, 16, 17, 24]:
+        vals = [rng.randrange(gl.P) for _ in range(width)]
+        dev = leaf_hash(jnp.asarray(np.array([vals], dtype=np.uint64)))[0]
+        host = Poseidon2SpongeHost.hash_leaf(vals)
+        assert [int(x) for x in np.asarray(dev)] == host
+
+
+def test_node_hash_matches_host():
+    l = [rng.randrange(gl.P) for _ in range(4)]
+    r = [rng.randrange(gl.P) for _ in range(4)]
+    dev = node_hash(
+        jnp.asarray(np.array([l], dtype=np.uint64)),
+        jnp.asarray(np.array([r], dtype=np.uint64)),
+    )[0]
+    assert [int(x) for x in np.asarray(dev)] == Poseidon2SpongeHost.hash_node(l, r)
+
+
+def test_merkle_tree_with_cap_roundtrip():
+    num_leaves, width, cap = 64, 5, 4
+    leaves = np.random.randint(0, gl.P, size=(num_leaves, width), dtype=np.uint64)
+    tree = MerkleTreeWithCap(jnp.asarray(leaves), cap)
+    assert len(tree.get_cap()) == cap
+    for idx in [0, 1, 31, 63, rng.randrange(num_leaves)]:
+        proof = tree.get_proof(idx)
+        assert len(proof) == 4  # log2(64/4)
+        ok = verify_proof_over_cap(list(leaves[idx]), proof, tree.get_cap(), idx)
+        assert ok
+        # tampered leaf must fail
+        bad = list(leaves[idx])
+        bad[0] = (bad[0] + 1) % gl.P
+        assert not verify_proof_over_cap(bad, proof, tree.get_cap(), idx)
+
+
+def test_merkle_multi_elems_per_leaf():
+    rows = np.random.randint(0, gl.P, size=(32, 3), dtype=np.uint64)
+    tree = MerkleTreeWithCap(jnp.asarray(rows), cap_size=2, num_elems_per_leaf=2)
+    assert tree.num_leaves == 16
+    flat = rows.reshape(16, 6)
+    proof = tree.get_proof(5)
+    assert verify_proof_over_cap(list(flat[5]), proof, tree.get_cap(), 5)
+
+
+def test_transcript_determinism_and_sensitivity():
+    def run(els):
+        t = Poseidon2Transcript()
+        t.witness_field_elements(els)
+        return t.get_multiple_challenges(20)
+
+    a = run([1, 2, 3])
+    assert a == run([1, 2, 3])
+    assert a != run([1, 2, 4])
+    # absorbing after drawing changes subsequent draws
+    t = Poseidon2Transcript()
+    t.witness_field_elements([5])
+    c1 = t.get_challenge()
+    t.witness_field_elements([9])
+    c2 = t.get_challenge()
+    t2 = Poseidon2Transcript()
+    t2.witness_field_elements([5])
+    assert t2.get_challenge() == c1
+    assert t2.get_challenge() != c2  # squeeze vs absorb-then-squeeze differ
+
+
+def test_bit_source():
+    t = Poseidon2Transcript()
+    t.witness_field_elements([42])
+    bs = BitSource(max_needed_bits=20)
+    idx = bs.get_index(t, 20)
+    assert 0 <= idx < (1 << 20)
+    # deterministic replay
+    t2 = Poseidon2Transcript()
+    t2.witness_field_elements([42])
+    bs2 = BitSource(max_needed_bits=20)
+    assert bs2.get_index(t2, 20) == idx
