@@ -1,0 +1,12 @@
+from .types import (
+    CSGeometry,
+    Place,
+    VAR,
+    WIT,
+    PLACEHOLDER,
+    var,
+    wit,
+    is_var,
+    is_wit,
+    place_index,
+)

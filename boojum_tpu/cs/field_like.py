@@ -1,0 +1,88 @@
+"""Field-like op contexts: one gate definition, many execution contexts.
+
+The reference achieves "write the constraint once, run it everywhere" with the
+`PrimeFieldLike` trait (`/root/reference/src/field/traits/field_like.rs:24`):
+the same gate evaluator runs over scalars (satisfiability checks), SIMD
+vectors (prover sweep) and circuit variables (recursive verifier). Here the
+same contract is a tiny duck-typed ops object:
+
+- ScalarOps    : python ints, base field        (satisfiability checker)
+- ArrayOps     : jnp uint64 arrays, base field  (prover quotient sweep — the
+                 whole LDE domain at once; XLA vectorizes)
+- ExtScalarOps : (int, int) tuples, GF(p^2)     (plain verifier at z)
+- circuit ops  : gadget Nums (recursive verifier, later layer)
+"""
+
+import jax.numpy as jnp
+
+from ..field import gl
+from ..field import extension as ext_f
+from ..field import goldilocks as gf
+
+
+class ScalarOps:
+    @staticmethod
+    def zero():
+        return 0
+
+    @staticmethod
+    def one():
+        return 1
+
+    @staticmethod
+    def constant(v: int):
+        return v % gl.P
+
+    add = staticmethod(gl.add)
+    sub = staticmethod(gl.sub)
+    mul = staticmethod(gl.mul)
+    neg = staticmethod(gl.neg)
+
+    @staticmethod
+    def double(a):
+        return gl.add(a, a)
+
+
+class ArrayOps:
+    """Base-field ops over whole jnp arrays (vectorized across domain rows)."""
+
+    @staticmethod
+    def zero():
+        return jnp.uint64(0)
+
+    @staticmethod
+    def one():
+        return jnp.uint64(1)
+
+    @staticmethod
+    def constant(v: int):
+        return jnp.uint64(v % gl.P)
+
+    add = staticmethod(gf.add)
+    sub = staticmethod(gf.sub)
+    mul = staticmethod(gf.mul)
+    neg = staticmethod(gf.neg)
+    double = staticmethod(gf.double)
+
+
+class ExtScalarOps:
+    @staticmethod
+    def zero():
+        return ext_f.ZERO_S
+
+    @staticmethod
+    def one():
+        return ext_f.ONE_S
+
+    @staticmethod
+    def constant(v: int):
+        return (v % gl.P, 0)
+
+    add = staticmethod(ext_f.add_s)
+    sub = staticmethod(ext_f.sub_s)
+    mul = staticmethod(ext_f.mul_s)
+    neg = staticmethod(ext_f.neg_s)
+
+    @staticmethod
+    def double(a):
+        return ext_f.add_s(a, a)

@@ -1,0 +1,19 @@
+from .base import Gate, RowView, TermsCollector
+from .simple import (
+    FmaGate,
+    ConstantsAllocatorGate,
+    BooleanConstraintGate,
+    NopGate,
+    PublicInputGate,
+    ReductionGate,
+    SelectionGate,
+    ZeroCheckGate,
+    ParallelSelectionGate,
+    ConditionalSwapGate,
+    DotProductGate,
+    QuadraticCombinationGate,
+    ReductionByPowersGate,
+    SimpleNonlinearityGate,
+    MatrixMultiplicationGate,
+)
+from .u32 import U32AddGate, U32SubGate, U32FmaGate, U32TriAddCarryAsChunkGate, UIntXAddGate

@@ -1,0 +1,76 @@
+"""Gate framework: evaluator contract + row views.
+
+Counterpart of the reference `Gate`/`GateConstraintEvaluator` traits
+(`/root/reference/src/cs/traits/gate.rs:72`, `traits/evaluator.rs:105`) and
+the trace-source/destination views (`traits/trace_source.rs`,
+`traits/destination_view.rs`). A gate subclass declares its geometry
+(columns per instance, constants per row, quotient terms, max degree) and one
+`evaluate(ops, row, dst)` over the field-like ops contract; that single
+definition drives:
+
+- the prover's quotient sweep (ArrayOps over the whole LDE domain, every
+  instance chunk, masked by the gate's selector path),
+- the satisfiability checker (ScalarOps per row),
+- the plain verifier's reconstruction at z (ExtScalarOps over values-at-z),
+- later, the recursive verifier (gadget ops).
+"""
+
+from __future__ import annotations
+
+
+class RowView:
+    """Access to one gate instance's cells, generic over backing storage.
+
+    v(i): i-th copy-permutation column of the instance;
+    w(i): i-th witness column of the instance;
+    c(i): i-th gate constant of the row.
+    """
+
+    def __init__(self, var_get, wit_get, const_get):
+        self.v = var_get
+        self.w = wit_get
+        self.c = const_get
+
+
+class TermsCollector:
+    def __init__(self):
+        self.terms = []
+
+    def push(self, value):
+        self.terms.append(value)
+
+
+class Gate:
+    """Base gate. Subclasses set class attrs and implement evaluate()."""
+
+    name: str = "?"
+    principal_width: int = 0  # copy columns per instance
+    witness_width: int = 0  # witness columns per instance
+    num_constants: int = 0  # constant columns consumed per row
+    num_terms: int = 0  # quotient terms per instance
+    max_degree: int = 0  # max constraint degree over the trace polys
+
+    def evaluate(self, ops, row: RowView, dst: TermsCollector):
+        raise NotImplementedError
+
+    def num_repetitions(self, geometry) -> int:
+        """Instances packed into one general-purpose row."""
+        if self.principal_width == 0:
+            return 1
+        per_copy = geometry.num_columns_under_copy_permutation // self.principal_width
+        if self.witness_width:
+            per_wit = geometry.num_witness_columns // self.witness_width
+            per_copy = min(per_copy, per_wit)
+        return per_copy
+
+    def padding_instance(self, cs, constants=()) -> list:
+        """Variable places filling one vacant instance so its terms vanish.
+
+        Default: zeros everywhere (valid whenever the constraint has no
+        affine offset). Gates that need a different filler override this.
+        """
+        zero = cs.zero_var()
+        return [zero] * self.principal_width
+
+    def __repr__(self):
+        return f"<gate {self.name}>"

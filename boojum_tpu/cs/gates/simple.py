@@ -1,0 +1,571 @@
+"""Core gate library (arithmetic / selection / structural gates).
+
+Each gate mirrors the constraint of its reference counterpart in
+`/root/reference/src/cs/gates/` (file noted per class) but is re-expressed as
+one vectorizable evaluator over the field-like ops contract. `add_to_cs`-style
+helpers live on the classes as static constructors that allocate outputs,
+register witness closures with the dataflow resolver, and place the instance.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+from .base import Gate, RowView, TermsCollector
+
+
+class FmaGate(Gate):
+    """c0·a·b + c1·c = d (reference fma_gate_without_constant.rs:138)."""
+
+    name = "fma"
+    principal_width = 4
+    num_constants = 2
+    num_terms = 1
+    max_degree = 3
+
+    def evaluate(self, ops, row, dst):
+        a, b, c, d = row.v(0), row.v(1), row.v(2), row.v(3)
+        c0, c1 = row.c(0), row.c(1)
+        t = ops.mul(c0, ops.mul(a, b))
+        t = ops.add(t, ops.mul(c1, c))
+        dst.push(ops.sub(t, d))
+
+    @staticmethod
+    def fma(cs, a, b, c, coeff_ab=1, coeff_c=1):
+        """Allocate and constrain d = coeff_ab·a·b + coeff_c·c."""
+        d = cs.alloc_variable_without_value()
+        ca, cc = coeff_ab % gl.P, coeff_c % gl.P
+
+        def resolve(vals):
+            av, bv, cv = vals
+            return [gl.add(gl.mul(ca, gl.mul(av, bv)), gl.mul(cc, cv))]
+
+        cs.set_values_with_dependencies([a, b, c], [d], resolve)
+        cs.place_gate(FmaGate.instance(), [a, b, c, d], (ca, cc))
+        return d
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ConstantsAllocatorGate(Gate):
+    """v = const (reference constant_allocator.rs); one constant per row,
+    amortized across all copy columns by the placement tooling."""
+
+    name = "constant"
+    principal_width = 1
+    num_constants = 1
+    num_terms = 1
+    max_degree = 1
+
+    def evaluate(self, ops, row, dst):
+        dst.push(ops.sub(row.v(0), row.c(0)))
+
+    def padding_instance(self, cs, constants=()):
+        c = constants[0] if constants else 0
+        v = cs.alloc_variable_without_value()
+        cs.set_values_with_dependencies([], [v], lambda _: [c])
+        return [v]
+
+    @staticmethod
+    def allocate_constant(cs, value: int):
+        value = value % gl.P
+        v = cs.alloc_variable_without_value()
+        cs.set_values_with_dependencies([], [v], lambda _, value=value: [value])
+        cs.place_gate(ConstantsAllocatorGate.instance(), [v], (value,))
+        return v
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class BooleanConstraintGate(Gate):
+    """x^2 = x (reference boolean_allocator.rs)."""
+
+    name = "boolean"
+    principal_width = 1
+    num_terms = 1
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        x = row.v(0)
+        dst.push(ops.sub(ops.mul(x, x), x))
+
+    @staticmethod
+    def enforce(cs, v):
+        cs.place_gate(BooleanConstraintGate.instance(), [v], ())
+        return v
+
+    @staticmethod
+    def allocate(cs, witness_fn=None, ins=()):
+        v = cs.alloc_variable_without_value()
+        if witness_fn is not None:
+            cs.set_values_with_dependencies(list(ins), [v], witness_fn)
+        BooleanConstraintGate.enforce(cs, v)
+        return v
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class NopGate(Gate):
+    """Row filler (reference nop_gate.rs); padding rows carry this gate."""
+
+    name = "nop"
+    principal_width = 0
+    num_terms = 0
+    max_degree = 0
+
+    def evaluate(self, ops, row, dst):
+        pass
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class PublicInputGate(Gate):
+    """Exposes a variable as a public input (reference public_input.rs).
+
+    No quotient term: the opening is enforced in the DEEP phase as an extra
+    (w_col(x) − value)/(x − ω^row) term, as the reference prover does
+    (prover.rs:1805 public_input_opening_tuples).
+    """
+
+    name = "public_input"
+    principal_width = 1
+    num_terms = 0
+    max_degree = 0
+
+    def evaluate(self, ops, row, dst):
+        pass
+
+    @staticmethod
+    def place(cs, v):
+        col, row = cs.place_gate(PublicInputGate.instance(), [v], ())
+        cs.set_public(col, row)
+        return v
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ReductionGate(Gate):
+    """sum_i coeff_i·x_i = out, N=4 terms (reference reduction_gate.rs)."""
+
+    name = "reduction4"
+    principal_width = 5
+    num_constants = 4
+    num_terms = 1
+    max_degree = 1
+
+    def evaluate(self, ops, row, dst):
+        acc = ops.zero()
+        for i in range(4):
+            acc = ops.add(acc, ops.mul(row.v(i), row.c(i)))
+        dst.push(ops.sub(acc, row.v(4)))
+
+    @staticmethod
+    def reduce(cs, vars4, coeffs4):
+        assert len(vars4) == 4 and len(coeffs4) == 4
+        out = cs.alloc_variable_without_value()
+        cf = [c % gl.P for c in coeffs4]
+
+        def resolve(vals):
+            acc = 0
+            for v, c in zip(vals, cf):
+                acc = gl.add(acc, gl.mul(v, c))
+            return [acc]
+
+        cs.set_values_with_dependencies(list(vars4), [out], resolve)
+        cs.place_gate(ReductionGate.instance(), list(vars4) + [out], tuple(cf))
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ReductionByPowersGate(Gate):
+    """sum_i c^i·x_i = out (reference reduction_by_powers_gate.rs)."""
+
+    name = "reduction_by_powers4"
+    principal_width = 5
+    num_constants = 1
+    num_terms = 1
+    max_degree = 1
+
+    def evaluate(self, ops, row, dst):
+        c = row.c(0)
+        acc = row.v(0)
+        cp = c
+        for i in range(1, 4):
+            acc = ops.add(acc, ops.mul(row.v(i), cp))
+            cp = ops.mul(cp, c)
+        dst.push(ops.sub(acc, row.v(4)))
+
+    @staticmethod
+    def reduce(cs, vars4, base):
+        out = cs.alloc_variable_without_value()
+        b = base % gl.P
+
+        def resolve(vals):
+            acc, cp = 0, 1
+            for v in vals:
+                acc = gl.add(acc, gl.mul(v, cp))
+                cp = gl.mul(cp, b)
+            return [acc]
+
+        cs.set_values_with_dependencies(list(vars4), [out], resolve)
+        cs.place_gate(ReductionByPowersGate.instance(), list(vars4) + [out], (b,))
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class SelectionGate(Gate):
+    """out = sel ? a : b  ==  sel·(a−b) + b − out (reference selection_gate.rs)."""
+
+    name = "selection"
+    principal_width = 4
+    num_terms = 1
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        a, b, sel, out = row.v(0), row.v(1), row.v(2), row.v(3)
+        t = ops.mul(sel, ops.sub(a, b))
+        dst.push(ops.sub(ops.add(t, b), out))
+
+    @staticmethod
+    def select(cs, sel, a, b):
+        out = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            av, bv, sv = vals
+            return [av if sv == 1 else bv]
+
+        cs.set_values_with_dependencies([a, b, sel], [out], resolve)
+        cs.place_gate(SelectionGate.instance(), [a, b, sel, out], ())
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ParallelSelectionGate(Gate):
+    """Shared-selector 4-wide select (reference parallel_selection.rs)."""
+
+    name = "parallel_selection4"
+    principal_width = 13  # sel + 4*(a,b,out)
+    num_terms = 4
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        sel = row.v(0)
+        for i in range(4):
+            a, b, out = row.v(1 + 3 * i), row.v(2 + 3 * i), row.v(3 + 3 * i)
+            t = ops.mul(sel, ops.sub(a, b))
+            dst.push(ops.sub(ops.add(t, b), out))
+
+    @staticmethod
+    def select(cs, sel, a_list, b_list):
+        assert len(a_list) == 4 and len(b_list) == 4
+        outs = [cs.alloc_variable_without_value() for _ in range(4)]
+
+        def resolve(vals):
+            sv = vals[0]
+            avs, bvs = vals[1:5], vals[5:9]
+            return [a if sv == 1 else b for a, b in zip(avs, bvs)]
+
+        cs.set_values_with_dependencies(
+            [sel] + list(a_list) + list(b_list), outs, resolve
+        )
+        flat = [sel]
+        for a, b, o in zip(a_list, b_list, outs):
+            flat += [a, b, o]
+        cs.place_gate(ParallelSelectionGate.instance(), flat, ())
+        return outs
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ConditionalSwapGate(Gate):
+    """(x, y) = sel ? (b, a) : (a, b) (reference conditional_swap.rs)."""
+
+    name = "conditional_swap"
+    principal_width = 5
+    num_terms = 2
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        sel, a, b, x, y = (row.v(i) for i in range(5))
+        d = ops.mul(sel, ops.sub(b, a))
+        dst.push(ops.sub(ops.add(a, d), x))  # x = a + sel(b-a)
+        dst.push(ops.add(ops.sub(b, d), ops.neg(y)))  # y = b - sel(b-a)
+
+    @staticmethod
+    def swap(cs, sel, a, b):
+        x = cs.alloc_variable_without_value()
+        y = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            sv, av, bv = vals
+            return ([bv, av] if sv == 1 else [av, bv])
+
+        cs.set_values_with_dependencies([sel, a, b], [x, y], resolve)
+        cs.place_gate(ConditionalSwapGate.instance(), [sel, a, b, x, y], ())
+        return x, y
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class DotProductGate(Gate):
+    """sum of 4 products = out (reference dot_product_gate.rs)."""
+
+    name = "dot_product4"
+    principal_width = 9
+    num_terms = 1
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        acc = ops.zero()
+        for i in range(4):
+            acc = ops.add(acc, ops.mul(row.v(2 * i), row.v(2 * i + 1)))
+        dst.push(ops.sub(acc, row.v(8)))
+
+    @staticmethod
+    def dot(cs, pairs):
+        assert len(pairs) == 4
+        out = cs.alloc_variable_without_value()
+        flat = [v for p in pairs for v in p]
+
+        def resolve(vals):
+            acc = 0
+            for i in range(4):
+                acc = gl.add(acc, gl.mul(vals[2 * i], vals[2 * i + 1]))
+            return [acc]
+
+        cs.set_values_with_dependencies(flat, [out], resolve)
+        cs.place_gate(DotProductGate.instance(), flat + [out], ())
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class QuadraticCombinationGate(Gate):
+    """sum of 4 products = 0 (reference quadratic_combination.rs)."""
+
+    name = "quadratic_combination4"
+    principal_width = 8
+    num_terms = 1
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        acc = ops.zero()
+        for i in range(4):
+            acc = ops.add(acc, ops.mul(row.v(2 * i), row.v(2 * i + 1)))
+        dst.push(acc)
+
+    @staticmethod
+    def enforce(cs, pairs):
+        assert len(pairs) == 4
+        flat = [v for p in pairs for v in p]
+        cs.place_gate(QuadraticCombinationGate.instance(), flat, ())
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ZeroCheckGate(Gate):
+    """out = (x == 0), with witness inverse aux (reference zero_check.rs).
+
+    Constraints: x·out = 0 and 1 − out − x·aux = 0 (aux = x^{-1} when x≠0).
+    """
+
+    name = "zero_check"
+    principal_width = 3
+    num_terms = 2
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        x, out, aux = row.v(0), row.v(1), row.v(2)
+        dst.push(ops.mul(x, out))
+        one = ops.one()
+        dst.push(ops.sub(ops.sub(one, out), ops.mul(x, aux)))
+
+    def padding_instance(self, cs, constants=()):
+        return [cs.zero_var(), cs.one_var(), cs.zero_var()]
+
+    @staticmethod
+    def is_zero(cs, x):
+        out = cs.alloc_variable_without_value()
+        aux = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            (xv,) = vals
+            if xv == 0:
+                return [1, 0]
+            return [0, gl.inv(xv)]
+
+        cs.set_values_with_dependencies([x], [out, aux], resolve)
+        cs.place_gate(ZeroCheckGate.instance(), [x, out, aux], ())
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class SimpleNonlinearityGate(Gate):
+    """y = x^7 + c (reference simple_non_linearity_with_constant.rs)."""
+
+    name = "nonlinearity7"
+    principal_width = 2
+    num_constants = 1
+    num_terms = 1
+    max_degree = 7
+
+    def evaluate(self, ops, row, dst):
+        x, y = row.v(0), row.v(1)
+        x2 = ops.mul(x, x)
+        x3 = ops.mul(x2, x)
+        x4 = ops.mul(x2, x2)
+        x7 = ops.mul(x4, x3)
+        dst.push(ops.sub(ops.add(x7, row.c(0)), y))
+
+    def padding_instance(self, cs, constants=()):
+        c = constants[0] if constants else 0
+        y = cs.alloc_variable_without_value()
+        cs.set_values_with_dependencies([], [y], lambda _, c=c: [c])
+        return [cs.zero_var(), y]
+
+    @staticmethod
+    def apply(cs, x, c: int):
+        y = cs.alloc_variable_without_value()
+        c = c % gl.P
+
+        def resolve(vals):
+            return [gl.add(gl.pow_(vals[0], 7), c)]
+
+        cs.set_values_with_dependencies([x], [y], resolve)
+        cs.place_gate(SimpleNonlinearityGate.instance(), [x, y], (c,))
+        return y
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class MatrixMultiplicationGate(Gate):
+    """out = M·in for a compile-time N×N matrix (reference
+    matrix_multiplication_gate.rs; used for Poseidon2 MDS layers).
+
+    The matrix is a gate *parameter* (not placed in constant columns); gates
+    with different matrices are distinct gate types.
+    """
+
+    num_constants = 0
+    max_degree = 1
+
+    def __init__(self, name: str, matrix):
+        self.name = f"matmul_{name}"
+        self.matrix = [[int(v) % gl.P for v in r] for r in matrix]
+        n = len(self.matrix)
+        self.n = n
+        self.principal_width = 2 * n
+        self.num_terms = n
+
+    def evaluate(self, ops, row, dst):
+        n = self.n
+        for i in range(n):
+            acc = ops.zero()
+            for j in range(n):
+                m = self.matrix[i][j]
+                if m == 0:
+                    continue
+                acc = ops.add(acc, ops.mul(ops.constant(m), row.v(j)))
+            dst.push(ops.sub(acc, row.v(n + i)))
+
+    def apply(self, cs, ins):
+        assert len(ins) == self.n
+        outs = [cs.alloc_variable_without_value() for _ in range(self.n)]
+        mat = self.matrix
+
+        def resolve(vals):
+            return [
+                sum(gl.mul(mat[i][j], vals[j]) for j in range(self.n)) % gl.P
+                for i in range(self.n)
+            ]
+
+        cs.set_values_with_dependencies(list(ins), outs, resolve)
+        cs.place_gate(self, list(ins) + outs, ())
+        return outs

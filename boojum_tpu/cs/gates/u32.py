@@ -1,0 +1,201 @@
+"""Fixed-width integer gates (reference u32_add.rs, u32_sub.rs, u32_fma.rs,
+u32_tri_add_carry_as_chunk.rs, uintx_add.rs).
+
+Range correctness of the 32-bit limbs themselves comes from lookup-table range
+checks at the gadget layer (as in the reference); these gates enforce the
+carry arithmetic relations.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+from .base import Gate
+
+SHIFT32 = 1 << 32
+
+
+class U32AddGate(Gate):
+    """a + b + carry_in = c + 2^32·carry_out; carry_out boolean."""
+
+    name = "u32_add"
+    principal_width = 5
+    num_terms = 2
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        a, b, cin, c, cout = (row.v(i) for i in range(5))
+        lhs = ops.add(ops.add(a, b), cin)
+        rhs = ops.add(c, ops.mul(ops.constant(SHIFT32), cout))
+        dst.push(ops.sub(lhs, rhs))
+        dst.push(ops.sub(ops.mul(cout, cout), cout))
+
+    @staticmethod
+    def add(cs, a, b, carry_in):
+        c = cs.alloc_variable_without_value()
+        cout = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            s = vals[0] + vals[1] + vals[2]
+            return [s & 0xFFFFFFFF, s >> 32]
+
+        cs.set_values_with_dependencies([a, b, carry_in], [c, cout], resolve)
+        cs.place_gate(U32AddGate.instance(), [a, b, carry_in, c, cout], ())
+        return c, cout
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class U32SubGate(Gate):
+    """a − b − borrow_in = c − 2^32·borrow_out; borrow_out boolean."""
+
+    name = "u32_sub"
+    principal_width = 5
+    num_terms = 2
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        a, b, bin_, c, bout = (row.v(i) for i in range(5))
+        lhs = ops.sub(ops.sub(a, b), bin_)
+        rhs = ops.sub(c, ops.mul(ops.constant(SHIFT32), bout))
+        dst.push(ops.sub(lhs, rhs))
+        dst.push(ops.sub(ops.mul(bout, bout), bout))
+
+    @staticmethod
+    def sub(cs, a, b, borrow_in):
+        c = cs.alloc_variable_without_value()
+        bout = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            d = vals[0] - vals[1] - vals[2]
+            if d < 0:
+                return [d + SHIFT32, 1]
+            return [d, 0]
+
+        cs.set_values_with_dependencies([a, b, borrow_in], [c, bout], resolve)
+        cs.place_gate(U32SubGate.instance(), [a, b, borrow_in, c, bout], ())
+        return c, bout
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class U32FmaGate(Gate):
+    """a·b + c + carry_in = low + 2^32·high (reference u32_fma.rs;
+    low/high range-checked at the gadget layer)."""
+
+    name = "u32_fma"
+    principal_width = 6
+    num_terms = 1
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        a, b, c, cin, low, high = (row.v(i) for i in range(6))
+        lhs = ops.add(ops.add(ops.mul(a, b), c), cin)
+        rhs = ops.add(low, ops.mul(ops.constant(SHIFT32), high))
+        dst.push(ops.sub(lhs, rhs))
+
+    @staticmethod
+    def fma(cs, a, b, c, carry_in):
+        low = cs.alloc_variable_without_value()
+        high = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            s = vals[0] * vals[1] + vals[2] + vals[3]
+            return [s & 0xFFFFFFFF, s >> 32]
+
+        cs.set_values_with_dependencies([a, b, c, carry_in], [low, high], resolve)
+        cs.place_gate(U32FmaGate.instance(), [a, b, c, carry_in, low, high], ())
+        return low, high
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class U32TriAddCarryAsChunkGate(Gate):
+    """a + b + c = low + 2^32·high, high in [0,2) ∪ {2} as a chunk
+    (reference u32_tri_add_carry_as_chunk.rs; high range-checked via lookups)."""
+
+    name = "u32_tri_add"
+    principal_width = 5
+    num_terms = 1
+    max_degree = 1
+
+    def evaluate(self, ops, row, dst):
+        a, b, c, low, high = (row.v(i) for i in range(5))
+        lhs = ops.add(ops.add(a, b), c)
+        rhs = ops.add(low, ops.mul(ops.constant(SHIFT32), high))
+        dst.push(ops.sub(lhs, rhs))
+
+    @staticmethod
+    def add(cs, a, b, c):
+        low = cs.alloc_variable_without_value()
+        high = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            s = vals[0] + vals[1] + vals[2]
+            return [s & 0xFFFFFFFF, s >> 32]
+
+        cs.set_values_with_dependencies([a, b, c], [low, high], resolve)
+        cs.place_gate(U32TriAddCarryAsChunkGate.instance(), [a, b, c, low, high], ())
+        return low, high
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class UIntXAddGate(Gate):
+    """Width-parameterized add: a + b + cin = c + 2^W·cout (reference
+    uintx_add.rs, W ∈ {8, 16, 32})."""
+
+    num_constants = 0
+    num_terms = 2
+    max_degree = 2
+    principal_width = 5
+
+    def __init__(self, width_bits: int):
+        assert width_bits in (8, 16, 32)
+        self.width_bits = width_bits
+        self.name = f"uint{width_bits}_add"
+        self.shift = 1 << width_bits
+
+    def evaluate(self, ops, row, dst):
+        a, b, cin, c, cout = (row.v(i) for i in range(5))
+        lhs = ops.add(ops.add(a, b), cin)
+        rhs = ops.add(c, ops.mul(ops.constant(self.shift), cout))
+        dst.push(ops.sub(lhs, rhs))
+        dst.push(ops.sub(ops.mul(cout, cout), cout))
+
+    def add(self, cs, a, b, carry_in):
+        c = cs.alloc_variable_without_value()
+        cout = cs.alloc_variable_without_value()
+        mask = self.shift - 1
+        bits = self.width_bits
+
+        def resolve(vals):
+            s = vals[0] + vals[1] + vals[2]
+            return [s & mask, s >> bits]
+
+        cs.set_values_with_dependencies([a, b, carry_in], [c, cout], resolve)
+        cs.place_gate(self, [a, b, carry_in, c, cout], ())
+        return c, cout

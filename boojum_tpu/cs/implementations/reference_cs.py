@@ -1,0 +1,310 @@
+"""Synthesis-time constraint system and its frozen, provable assembly.
+
+Counterpart of the reference `CSReferenceImplementation` /
+`CSReferenceAssembly` (`/root/reference/src/cs/implementations/reference_cs.rs:26`,
+placement logic in `implementations/cs.rs:63,112,427`, freeze at `:199-287`).
+
+Design differences (TPU-first):
+- placement data is dense numpy int64 arrays (column-major (cols, rows) of
+  place ids, -1 = vacant) so the witness scatter at freeze time is one
+  vectorized gather into device arrays — no per-cell objects;
+- gate constants and selector encoding are NOT written into constant columns
+  during synthesis; they are materialized at setup once the selector tree over
+  the finally-used gate set is known (reference does the same split:
+  setup.rs:486 + setup.rs:710);
+- the witness "DAG" is the eager batched resolver in `boojum_tpu.dag`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...field import gl
+from ..types import CSGeometry, CSConfig, DEV_CS_CONFIG, LookupParameters
+from ...dag import WitnessResolver, NullResolver
+from ..gates.base import Gate
+from ..gates.simple import ConstantsAllocatorGate
+
+
+class ConstraintSystem:
+    def __init__(
+        self,
+        geometry: CSGeometry,
+        max_trace_len: int,
+        config: CSConfig = DEV_CS_CONFIG,
+        lookup_params: LookupParameters | None = None,
+    ):
+        self.geometry = geometry
+        self.max_trace_len = max_trace_len
+        self.config = config
+        self.lookup_params = lookup_params or LookupParameters()
+        self.resolver = (
+            WitnessResolver() if config.evaluate_witness else NullResolver()
+        )
+        self.next_var_idx = 0
+        self.next_wit_idx = 0
+        c = geometry.num_columns_under_copy_permutation
+        w = geometry.num_witness_columns
+        self.copy_placement = np.full((c, max_trace_len), -1, dtype=np.int64)
+        self.wit_placement = np.full((w, max_trace_len), -1, dtype=np.int64)
+        self.row_gate = np.full(max_trace_len, -1, dtype=np.int32)
+        self.gates: list[Gate] = []
+        self.gate_index: dict[str, int] = {}
+        self.gate_constants: dict[int, tuple] = {}
+        self.next_row = 0
+        self._tooling: dict[tuple, list] = {}
+        self.public_inputs: list[tuple[int, int]] = []
+        self._zero_var = None
+        self._one_var = None
+        # lookups (specialized columns mode)
+        self.lookup_tables = []  # list of LookupTable
+        self._table_by_name = {}
+        self.lookup_rows: list[list[int]] = []  # per sub-argument: row-major keys
+        self.lookup_multiplicities: dict[int, int] | None = None
+
+    # ------------------------------------------------------------------
+    # allocation (reference implementations/cs.rs:63)
+    # ------------------------------------------------------------------
+
+    def alloc_variable_without_value(self) -> int:
+        place = self.next_var_idx << 1
+        self.next_var_idx += 1
+        return place
+
+    def alloc_multiple_variables_without_values(self, n: int) -> list[int]:
+        base = self.next_var_idx
+        self.next_var_idx += n
+        return [(base + i) << 1 for i in range(n)]
+
+    def alloc_witness_without_value(self) -> int:
+        place = (self.next_wit_idx << 1) | 1
+        self.next_wit_idx += 1
+        return place
+
+    def alloc_variable_with_value(self, value: int) -> int:
+        p = self.alloc_variable_without_value()
+        self.resolver.set_value(p, value % gl.P)
+        return p
+
+    def set_values_with_dependencies(self, ins, outs, fn):
+        """Register a witness closure (reference cs.rs:112)."""
+        self.resolver.add_resolution(ins, outs, fn)
+
+    def get_value(self, place: int) -> int:
+        return self.resolver.get_value(place)
+
+    # -- canonical constants ------------------------------------------------
+
+    def zero_var(self) -> int:
+        if self._zero_var is None:
+            self._zero_var = ConstantsAllocatorGate.allocate_constant(self, 0)
+        return self._zero_var
+
+    def one_var(self) -> int:
+        if self._one_var is None:
+            self._one_var = ConstantsAllocatorGate.allocate_constant(self, 1)
+        return self._one_var
+
+    def allocate_constant(self, value: int) -> int:
+        return ConstantsAllocatorGate.allocate_constant(self, value)
+
+    # ------------------------------------------------------------------
+    # gate placement (reference implementations/cs.rs:427)
+    # ------------------------------------------------------------------
+
+    def _register_gate(self, gate: Gate) -> int:
+        gid = self.gate_index.get(gate.name)
+        if gid is None:
+            gid = len(self.gates)
+            self.gates.append(gate)
+            self.gate_index[gate.name] = gid
+            # full check (path depth + constants) happens at setup time once
+            # the selector tree is known
+            assert gate.num_constants <= self.geometry.num_constant_columns
+        return gid
+
+    def place_gate(self, gate: Gate, var_places, constants=(), wit_places=()):
+        """Place one instance; returns (first_column, row) of the instance."""
+        gid = self._register_gate(gate)
+        key = (gate.name, tuple(constants))
+        reps = gate.num_repetitions(self.geometry)
+        assert reps >= 1, f"gate {gate.name} does not fit geometry"
+        tool = self._tooling.get(key)
+        if tool is None or tool[1] >= reps:
+            row = self.next_row
+            assert row < self.max_trace_len, "trace overflow"
+            self.next_row += 1
+            self.row_gate[row] = gid
+            if constants:
+                self.gate_constants[row] = tuple(int(c) % gl.P for c in constants)
+            tool = [row, 0]
+            self._tooling[key] = tool
+        row, used = tool
+        off = used * gate.principal_width
+        assert len(var_places) == gate.principal_width
+        for i, p in enumerate(var_places):
+            self.copy_placement[off + i, row] = p
+        if gate.witness_width:
+            woff = used * gate.witness_width
+            assert len(wit_places) == gate.witness_width
+            for i, p in enumerate(wit_places):
+                self.wit_placement[woff + i, row] = p
+        tool[1] = used + 1
+        return off, row
+
+    def set_public(self, column: int, row: int):
+        self.public_inputs.append((column, row))
+
+    # ------------------------------------------------------------------
+    # lookups (specialized-columns, log-derivative; reference
+    # lookup_placement.rs:112 + implementations/cs.rs:809)
+    # ------------------------------------------------------------------
+
+    def add_lookup_table(self, table) -> int:
+        """Register a LookupTable; returns its table id (ids start at 1,
+        reference reference_cs.rs:23)."""
+        assert table.name not in self._table_by_name
+        table_id = len(self.lookup_tables) + 1
+        self.lookup_tables.append(table)
+        self._table_by_name[table.name] = table_id
+        if self.lookup_multiplicities is None:
+            self.lookup_multiplicities = {}
+        return table_id
+
+    def get_table_id(self, name: str) -> int:
+        return self._table_by_name[name]
+
+    def get_table(self, table_id: int):
+        return self.lookup_tables[table_id - 1]
+
+    def enforce_lookup(self, table_id: int, keys: list[int]):
+        """Constrain tuple of variable places `keys` to be a row of table.
+
+        Placement into specialized lookup columns happens at freeze; here we
+        record the tuple and bump multiplicity eagerly via the resolver.
+        """
+        params = self.lookup_params
+        assert params.is_enabled, "lookups not configured"
+        assert len(keys) == params.width
+        self.lookup_rows.append((table_id, list(keys)))
+        if self.config.evaluate_witness:
+            table = self.get_table(table_id)
+
+            def bump(vals, table=table, table_id=table_id):
+                row_idx = table.row_index(tuple(vals))
+                key = (table_id, row_idx)
+                self.lookup_multiplicities[key] = (
+                    self.lookup_multiplicities.get(key, 0) + 1
+                )
+                return []
+
+            self.resolver.add_resolution(list(keys), [], bump)
+
+    def perform_lookup(self, table_id: int, key_places: list[int]) -> list[int]:
+        """Allocate output variables = table lookup of key variables."""
+        table = self.get_table(table_id)
+        num_outs = table.num_values
+        outs = self.alloc_multiple_variables_without_values(num_outs)
+
+        def resolve(vals, table=table):
+            return list(table.lookup_values(tuple(vals)))
+
+        self.set_values_with_dependencies(list(key_places), outs, resolve)
+        self.enforce_lookup(table_id, list(key_places) + outs)
+        return outs
+
+    # ------------------------------------------------------------------
+    # finalization / freeze (reference setup.rs:99 pad_and_shrink +
+    # reference_cs.rs:257 into_assembly)
+    # ------------------------------------------------------------------
+
+    def pad_and_shrink(self):
+        from ..gates.simple import NopGate
+
+        # complete partially-filled gate rows with padding instances; padding
+        # may itself allocate helper constants (zero/one vars -> new constant
+        # rows), so iterate to a fixpoint
+        while True:
+            unfinished = [
+                (key, tool)
+                for key, tool in self._tooling.items()
+                if tool[1]
+                < self.gates[self.gate_index[key[0]]].num_repetitions(self.geometry)
+            ]
+            if not unfinished:
+                break
+            for (gname, constants), tool in unfinished:
+                gate = self.gates[self.gate_index[gname]]
+                reps = gate.num_repetitions(self.geometry)
+                row, used = tool
+                while used < reps:
+                    off = used * gate.principal_width
+                    pads = gate.padding_instance(self, constants)
+                    for i, p in enumerate(pads):
+                        self.copy_placement[off + i, row] = p
+                    used += 1
+                tool[1] = used
+        # round up to a power of two; vacant rows become NOP rows
+        n = 1 << max(3, (max(self.next_row, 1) - 1).bit_length())
+        assert n <= self.max_trace_len
+        nop_gid = self._register_gate(NopGate.instance())
+        self.row_gate[: n][self.row_gate[:n] < 0] = nop_gid
+        self.trace_len = n
+        return n
+
+    def into_assembly(self) -> "CSAssembly":
+        self.resolver.wait_till_resolved()
+        n = getattr(self, "trace_len", None) or self.pad_and_shrink()
+        num_places = 2 * max(self.next_var_idx, self.next_wit_idx) + 2
+        arena = self.resolver.values
+        if len(arena) < num_places:
+            grown = np.zeros(num_places, dtype=np.uint64)
+            grown[: len(arena)] = arena
+            arena = grown
+
+        def scatter(placement):
+            pl = placement[:, :n]
+            safe = np.where(pl >= 0, pl, 0)
+            vals = arena[safe]
+            vals[pl < 0] = 0
+            return vals.astype(np.uint64)
+
+        copy_cols = scatter(self.copy_placement)
+        wit_cols = scatter(self.wit_placement)
+        return CSAssembly(
+            geometry=self.geometry,
+            lookup_params=self.lookup_params,
+            trace_len=n,
+            gates=self.gates,
+            row_gate=self.row_gate[:n].copy(),
+            gate_constants=dict(self.gate_constants),
+            copy_placement=self.copy_placement[:, :n],
+            wit_placement=self.wit_placement[:, :n],
+            copy_cols_values=copy_cols,
+            wit_cols_values=wit_cols,
+            public_inputs=[
+                (c, r, self.get_value(int(self.copy_placement[c, r])))
+                for (c, r) in self.public_inputs
+            ]
+            if self.config.evaluate_witness
+            else [(c, r, 0) for (c, r) in self.public_inputs],
+            lookup_tables=self.lookup_tables,
+            lookup_rows=self.lookup_rows,
+            lookup_multiplicities=self.lookup_multiplicities,
+            resolver=self.resolver,
+        )
+
+
+class CSAssembly:
+    """Frozen, provable CS (reference CSReferenceAssembly)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    @property
+    def num_copy_cols(self):
+        return self.geometry.num_columns_under_copy_permutation
+
+    @property
+    def num_wit_cols(self):
+        return self.geometry.num_witness_columns

@@ -1,0 +1,92 @@
+"""CS data model: places, geometry, configs.
+
+Counterpart of the reference's `Place`/`Variable`/`Witness` (u48 + tag bits,
+`/root/reference/src/cs/mod.rs:35,155,185`), `CSGeometry` (`:218`) and the
+type-level `CSConfig` bundles (`src/config.rs:27`). Python-side synthesis is
+hot (millions of allocations), so places are plain ints with a tag bit rather
+than objects: variable k -> 2k, witness k -> 2k+1, placeholder -> -1.
+"""
+
+from dataclasses import dataclass, field
+
+PLACEHOLDER = -1
+VAR = 0
+WIT = 1
+
+
+def var(idx: int) -> int:
+    return idx << 1
+
+
+def wit(idx: int) -> int:
+    return (idx << 1) | 1
+
+
+def is_var(place: int) -> bool:
+    return place >= 0 and (place & 1) == 0
+
+
+def is_wit(place: int) -> bool:
+    return place >= 0 and (place & 1) == 1
+
+
+def place_index(place: int) -> int:
+    assert place >= 0
+    return place >> 1
+
+
+Place = int  # alias for documentation
+
+
+@dataclass(frozen=True)
+class CSGeometry:
+    """Trace shape (reference `CSGeometry`, src/cs/mod.rs:218)."""
+
+    num_columns_under_copy_permutation: int
+    num_witness_columns: int
+    num_constant_columns: int
+    max_allowed_constraint_degree: int
+
+
+@dataclass(frozen=True)
+class LookupParameters:
+    """Lookup configuration (reference `LookupParameters`, src/cs/mod.rs:227).
+
+    Only the specialized-columns log-derivative mode is implemented for now
+    (the mode the SHA-256 benchmark uses); width = number of key-value columns
+    per sub-argument (excluding the table-id column), num_repetitions = number
+    of parallel sub-arguments, share_table_id = table id column folded into
+    the key columns.
+    """
+
+    width: int = 0
+    num_repetitions: int = 0
+    share_table_id: bool = True
+    use_specialized_columns: bool = True
+
+    @property
+    def is_enabled(self) -> bool:
+        return self.num_repetitions > 0
+
+    @property
+    def specialized_columns_per_subargument(self) -> int:
+        return self.width + (0 if self.share_table_id else 1)
+
+
+@dataclass
+class CSConfig:
+    """Runtime analogue of the reference's type-level config bundles.
+
+    evaluate_witness: run witness resolution (off for setup-only synthesis);
+    runtime_asserts: extra invariant checks during synthesis;
+    keep_setup: retain placement data needed for setup/VK generation.
+    """
+
+    evaluate_witness: bool = True
+    runtime_asserts: bool = True
+    keep_setup: bool = True
+
+
+DEV_CS_CONFIG = CSConfig(True, True, True)
+PROVING_CS_CONFIG = CSConfig(True, False, False)
+SETUP_CS_CONFIG = CSConfig(False, True, True)

@@ -1,0 +1,124 @@
+"""Witness-resolution dataflow engine (host side).
+
+The reference resolves witness closures on a worker-thread pipeline overlapped
+with synthesis (`/root/reference/src/dag/resolvers/mt/mod.rs:100`
+MtCircuitResolver; single-threaded semantics in `resolvers/st.rs`). The
+TPU-native design keeps resolution on the host but *eager and batched*:
+closures run immediately when their inputs are already known (the common case
+— gadget code computes forward), otherwise they are parked on their missing
+inputs and flushed by the dependency that arrives last. Gadget helpers
+register ONE closure for a whole vector of allocations (`set_values_batch`),
+which is what makes python-side witness generation scale — the analogue of
+the reference Guide's span batching (`src/dag/guide.rs:129`).
+
+Values live in a growable numpy uint64 arena; the device witness scatter
+reads it zero-copy at freeze time.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..cs.types import is_var, is_wit, place_index
+
+
+class WitnessResolver:
+    def __init__(self, capacity: int = 1 << 16):
+        self.values = np.zeros(capacity, dtype=np.uint64)
+        self.resolved = np.zeros(capacity, dtype=bool)
+        # place -> list of closure records waiting on it
+        self._waiters: dict[int, list] = {}
+        self._num_pending = 0
+
+    # -- storage ------------------------------------------------------------
+
+    def _ensure(self, idx: int):
+        if idx >= len(self.values):
+            new_cap = max(len(self.values) * 2, idx + 1)
+            new_values = np.zeros(new_cap, dtype=np.uint64)
+            new_values[: len(self.values)] = self.values
+            new_resolved = np.zeros(new_cap, dtype=bool)
+            new_resolved[: len(self.resolved)] = self.resolved
+            self.values = new_values
+            self.resolved = new_resolved
+
+    def is_resolved(self, place: int) -> bool:
+        idx = place
+        return idx < len(self.resolved) and bool(self.resolved[idx])
+
+    def get_value(self, place: int) -> int:
+        assert self.is_resolved(place), f"place {place} unresolved"
+        return int(self.values[place])
+
+    def set_value(self, place: int, value: int):
+        self._ensure(place)
+        assert not self.resolved[place], f"place {place} set twice"
+        self.values[place] = value
+        self.resolved[place] = True
+        waiters = self._waiters.pop(place, None)
+        if waiters:
+            for rec in waiters:
+                rec[0] -= 1
+                if rec[0] == 0:
+                    self._num_pending -= 1
+                    self._run(rec[1], rec[2], rec[3])
+
+    # -- resolutions --------------------------------------------------------
+
+    def add_resolution(self, ins: list, outs: list, fn):
+        """Register fn(list_of_input_ints) -> list_of_output_ints.
+
+        Runs immediately if all inputs are resolved (the hot path).
+        """
+        missing = [p for p in ins if not self.is_resolved(p)]
+        if not missing:
+            self._run(ins, outs, fn)
+            return
+        rec = [len(missing), ins, outs, fn]
+        self._num_pending += 1
+        for p in missing:
+            self._waiters.setdefault(p, []).append(rec)
+
+    def _run(self, ins, outs, fn):
+        in_vals = [int(self.values[p]) for p in ins]
+        out_vals = fn(in_vals)
+        assert len(out_vals) == len(outs), "resolver arity mismatch"
+        for p, v in zip(outs, out_vals):
+            self.set_value(p, int(v))
+
+    def wait_till_resolved(self):
+        """All registered resolutions must have fired (reference
+        `wait_till_resolved`, dag/resolvers/mt/mod.rs)."""
+        if self._num_pending:
+            unresolved = [p for p, w in self._waiters.items() if w]
+            raise RuntimeError(
+                f"{self._num_pending} witness resolutions never fired; "
+                f"first unresolved places: {unresolved[:10]}"
+            )
+
+    # -- bulk views ---------------------------------------------------------
+
+    def values_flat(self, count: int) -> np.ndarray:
+        """Dense value vector for places [0, count) (vars+wits interleaved)."""
+        assert self.resolved[:count].all(), "unresolved places in flat dump"
+        return self.values[:count]
+
+
+class NullResolver(WitnessResolver):
+    """Setup-mode no-op resolver (reference NullCircuitResolver,
+    dag/resolvers/null.rs): accepts registrations, stores nothing."""
+
+    def __init__(self):
+        super().__init__(capacity=1)
+
+    def set_value(self, place: int, value: int):
+        pass
+
+    def add_resolution(self, ins, outs, fn):
+        pass
+
+    def is_resolved(self, place: int) -> bool:
+        return False
+
+    def wait_till_resolved(self):
+        pass

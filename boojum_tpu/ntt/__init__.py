@@ -6,6 +6,7 @@ from .ntt import (
     ifft_bitreversed_to_natural,
     ifft_natural_to_natural,
     powers_device,
+    ext_powers_device,
     distribute_powers,
     lde_from_monomial,
     monomial_from_values,

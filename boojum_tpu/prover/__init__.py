@@ -1,0 +1,5 @@
+from .config import ProofConfig
+from .setup import SetupData, VerificationKey, generate_setup
+from .prover import prove
+from .verifier import verify
+from .proof import Proof

@@ -1,0 +1,16 @@
+"""Proof configuration (reference ProofConfig, prover.rs:55)."""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class ProofConfig:
+    fri_lde_factor: int = 8
+    merkle_tree_cap_size: int = 16
+    num_queries: int = 50
+    pow_bits: int = 0
+    fri_final_degree: int = 64  # stop folding when poly degree <= this
+
+    def __post_init__(self):
+        assert self.fri_lde_factor & (self.fri_lde_factor - 1) == 0
+        assert self.merkle_tree_cap_size & (self.merkle_tree_cap_size - 1) == 0

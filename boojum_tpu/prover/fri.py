@@ -1,0 +1,181 @@
+"""FRI: commit-and-fold low-degreeness argument over the quadratic extension.
+
+Counterpart of `/root/reference/src/cs/implementations/fri/mod.rs` (do_fri
+:49, fold_multiple :362, final monomial interpolation :476). The codeword is
+an ext-valued array over the full LDE domain in bit-reversed enumeration, so
+fold pairs (x, −x) are ADJACENT (even/odd lanes) and every fold round is two
+strided slices + vectorized butterfly — no gather. Each committed round
+interleaves (c0, c1) with two domain points per Merkle leaf.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import extension as ext_f
+from ..field import goldilocks as gf
+from ..merkle import MerkleTreeWithCap
+from ..ntt import (
+    bitreverse_indices,
+    get_ntt_context,
+    distribute_powers,
+    ifft_bitreversed_to_natural,
+    powers_device,
+)
+from .stages import ext_scalar
+
+INV2 = (gl.P + 1) // 2
+
+
+def fold_challenge_tables(log_full: int, num_rounds: int):
+    """Per-round inverse-x tables: round r domain is the coset
+    g^(2^r)·H_{N>>r}; table r holds 1/x at pair positions (even bit-reversed
+    indices), length (N >> r)/2."""
+    tables = []
+    for r in range(num_rounds):
+        log_nr = log_full - r
+        n_r = 1 << log_nr
+        shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << r)
+        omega = gl.omega(log_nr)
+        xs_nat = powers_device(omega, n_r)
+        xs_nat = gf.mul(xs_nat, jnp.uint64(shift))
+        brev = bitreverse_indices(log_nr)
+        xs_brev = xs_nat[jnp.asarray(brev)]
+        xs_pairs = xs_brev[0::2]
+        tables.append(gf.batch_inverse(xs_pairs))
+    return tables
+
+
+def fold_once(values, challenge, inv_x_pairs):
+    """values: ext pair over round-r domain (brev layout); returns N/2 ext.
+
+    f'(x^2) = (f(x)+f(-x))/2 + ch·(f(x)-f(-x))/(2x).
+    """
+    ch = ext_scalar(challenge)
+    a = (values[0][0::2], values[1][0::2])
+    bm = (values[0][1::2], values[1][1::2])
+    s = ext_f.add(a, bm)
+    d = ext_f.sub(a, bm)
+    d_over_x = (gf.mul(d[0], inv_x_pairs), gf.mul(d[1], inv_x_pairs))
+    t = ext_f.add(s, ext_f.mul(d_over_x, ch))
+    inv2 = jnp.uint64(INV2)
+    return (gf.mul(t[0], inv2), gf.mul(t[1], inv2))
+
+
+def commit_codeword(values, cap_size: int) -> MerkleTreeWithCap:
+    """Commit ext codeword: rows (N, 2) = [c0, c1], two points per leaf."""
+    arr = jnp.stack([values[0], values[1]], axis=-1)  # (N, 2)
+    return MerkleTreeWithCap(arr, cap_size, num_elems_per_leaf=2)
+
+
+class FriOracles:
+    def __init__(self):
+        self.trees: list[MerkleTreeWithCap] = []
+        self.values: list = []  # ext pairs per round (device)
+        self.challenges: list = []
+        self.final_monomials = None  # host list of (c0, c1)
+
+
+def fri_prove(codeword, transcript, config, base_degree: int) -> FriOracles:
+    """codeword: ext pair over full LDE domain (brev layout).
+
+    Protocol: commit base oracle -> absorb cap -> repeat [draw challenge,
+    fold; commit+absorb unless final] -> interpolate final monomials, absorb.
+    """
+    out = FriOracles()
+    N = int(codeword[0].shape[0])
+    log_full = N.bit_length() - 1
+    deg = base_degree
+    num_folds = 0
+    while deg > config.fri_final_degree:
+        deg //= 2
+        num_folds += 1
+    assert num_folds >= 1, "nothing to fold; lower fri_final_degree"
+    tables = fold_challenge_tables(log_full, num_folds)
+
+    cur = codeword
+    tree = commit_codeword(cur, config.merkle_tree_cap_size)
+    out.trees.append(tree)
+    out.values.append(cur)
+    transcript.witness_merkle_tree_cap(tree.get_cap())
+    for r in range(num_folds):
+        ch = transcript.get_ext_challenge()
+        out.challenges.append(ch)
+        cur = fold_once(cur, ch, tables[r])
+        if r + 1 < num_folds:
+            tree = commit_codeword(cur, config.merkle_tree_cap_size)
+            out.trees.append(tree)
+            out.values.append(cur)
+            transcript.witness_merkle_tree_cap(tree.get_cap())
+    # final interpolation over coset g^(2^R)·H_{N>>R}
+    n_fin = N >> num_folds
+    shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
+    mono0 = distribute_powers(ifft_bitreversed_to_natural(cur[0]), shift_inv)
+    mono1 = distribute_powers(ifft_bitreversed_to_natural(cur[1]), shift_inv)
+    m0 = np.asarray(mono0)
+    m1 = np.asarray(mono1)
+    deg_bound = base_degree >> num_folds
+    assert (m0[deg_bound:] == 0).all() and (m1[deg_bound:] == 0).all(), (
+        "final FRI polynomial exceeds degree bound"
+    )
+    out.final_monomials = [(int(a), int(b)) for a, b in zip(m0[:deg_bound], m1[:deg_bound])]
+    for c0, c1 in out.final_monomials:
+        transcript.witness_field_elements([c0, c1])
+    out.num_folds = num_folds
+    return out
+
+
+def fri_verify_queries(
+    proof_fri, challenges, final_monomials, query_index: int, query_data,
+    log_full: int, num_folds: int,
+):
+    """Check one query's fold chain on host (python ints).
+
+    query_data: list over rounds of (pair_values) where pair_values =
+    [(c0,c1) at even idx, (c0,c1) at odd idx] for the round's pair containing
+    the query. Returns True iff the chain folds into the final polynomial.
+    """
+    idx = query_index
+    cur_pair_expected = None
+    for r in range(num_folds):
+        log_nr = log_full - r
+        pair = query_data[r]
+        even, odd = pair
+        if cur_pair_expected is not None:
+            mine = even if (idx & 1) == 0 else odd
+            if tuple(mine) != tuple(cur_pair_expected):
+                return False
+        # fold
+        k = idx >> 1
+        shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << r)
+        n_r = 1 << log_nr
+        # x at brev position 2k: natural index brev(2k)
+        nat = _brev(2 * k, log_nr)
+        x = gl.mul(shift, gl.pow_(gl.omega(log_nr), nat))
+        ch = challenges[r]
+        s = ext_f.add_s(even, odd)
+        d = ext_f.sub_s(even, odd)
+        dox = ext_f.mul_by_base_s(d, gl.inv(x))
+        t = ext_f.add_s(s, ext_f.mul_s(dox, ch))
+        cur_pair_expected = ext_f.mul_by_base_s(t, INV2)
+        idx = k
+    # final check: evaluate final monomials at the folded domain point
+    log_fin = log_full - num_folds
+    nat = _brev(idx, log_fin)
+    shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds)
+    x = gl.mul(shift, gl.pow_(gl.omega(log_fin), nat))
+    acc = ext_f.ZERO_S
+    xp = ext_f.ONE_S
+    for c in final_monomials:
+        acc = ext_f.add_s(acc, ext_f.mul_s(c, xp))
+        xp = ext_f.mul_by_base_s(xp, x)
+    return tuple(acc) == tuple(cur_pair_expected)
+
+
+def _brev(i: int, bits: int) -> int:
+    out = 0
+    for b in range(bits):
+        out |= ((i >> b) & 1) << (bits - 1 - b)
+    return out

@@ -1,0 +1,37 @@
+"""Proof-of-work grinding (reference `PoWRunner`, pow.rs:7).
+
+Algebraic Poseidon2 PoW: seed = 4 transcript challenges; find a u64 nonce
+such that hash(seed ‖ nonce)[0] has `pow_bits` low zero bits. The nonce is
+absorbed back into the transcript before query-index sampling so queries are
+grinding-bound. (The reference's Blake2s/Keccak256 byte-oriented runners are
+an alternative backend to add alongside.)
+"""
+
+from ..hashes.poseidon2 import Poseidon2SpongeHost
+
+
+def pow_grind(transcript, pow_bits: int) -> int:
+    if pow_bits == 0:
+        return 0
+    assert pow_bits <= 32, "unreasonable pow difficulty"
+    seed = transcript.get_multiple_challenges(4)
+    mask = (1 << pow_bits) - 1
+    nonce = 0
+    while True:
+        h = Poseidon2SpongeHost.hash_leaf(seed + [nonce])
+        if h[0] & mask == 0:
+            break
+        nonce += 1
+    transcript.witness_field_elements([nonce])
+    return nonce
+
+
+def pow_verify(transcript, pow_bits: int, nonce: int) -> bool:
+    if pow_bits == 0:
+        return True
+    seed = transcript.get_multiple_challenges(4)
+    h = Poseidon2SpongeHost.hash_leaf(seed + [int(nonce)])
+    if h[0] & ((1 << pow_bits) - 1) != 0:
+        return False
+    transcript.witness_field_elements([nonce])
+    return True

@@ -1,0 +1,336 @@
+"""The 5-round IOP prover (reference `prove_cpu_basic`, prover.rs:153).
+
+Round structure (transcript order is the protocol; the verifier replays it):
+  0. absorb setup cap + public inputs
+  1. commit witness columns (monomial -> coset LDE -> Merkle) ... draw beta, gamma
+  2. commit stage-2 (copy-permutation z + partial products)   ... draw alpha
+  3. commit quotient chunks                                   ... draw z
+  4. absorb evaluations at z (and z*omega for the grand product) ... draw DEEP
+  5. DEEP quotening -> FRI fold rounds -> queries
+
+Every polynomial op in rounds 1-3 and 5 is a whole-array device computation;
+the host only sequences rounds, runs the transcript, and gathers query paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import extension as ext_f
+from ..field import goldilocks as gf
+from ..merkle import MerkleTreeWithCap
+from ..ntt import (
+    bitreverse_indices,
+    ext_powers_device,
+    eval_monomial_at_ext_point,
+    distribute_powers,
+    get_ntt_context,
+    ifft_bitreversed_to_natural,
+    lde_from_monomial,
+    monomial_from_values,
+    powers_device,
+)
+from ..transcript import BitSource, Poseidon2Transcript
+from .config import ProofConfig
+from .fri import fri_prove
+from .pow import pow_grind
+from .proof import OracleQuery, Proof, SingleRoundQueries
+from .stages import (
+    alpha_powers_iter,
+    compute_copy_permutation_stage2,
+    copy_permutation_quotient_terms,
+    ext_scalar,
+    gate_terms_contribution,
+)
+
+
+def _commit_columns(lde, cap_size):
+    """lde: (B, L, n) -> Merkle tree over (L*n, B) leaves."""
+    B = lde.shape[0]
+    leaves = lde.reshape(B, -1).T
+    return MerkleTreeWithCap(leaves, cap_size), leaves
+
+
+def _domain_xs_brev(log_n, lde_factor):
+    """Full LDE domain values g·w_N^i in bit-reversed enumeration."""
+    log_full = log_n + (lde_factor.bit_length() - 1)
+    N = 1 << log_full
+    xs = powers_device(gl.omega(log_full), N)
+    xs = gf.mul(xs, jnp.uint64(gl.MULTIPLICATIVE_GENERATOR))
+    return xs[jnp.asarray(bitreverse_indices(log_full))]
+
+
+def _vanishing_inv_brev(log_n, lde_factor):
+    """1/(x^n - 1) over the LDE domain (per-coset constants, brev order)."""
+    n = 1 << log_n
+    log_lde = lde_factor.bit_length() - 1
+    brev_lde = bitreverse_indices(log_lde)
+    w_full = gl.omega(log_n + log_lde)
+    vals = []
+    for jb in brev_lde:
+        shift = gl.mul(gl.MULTIPLICATIVE_GENERATOR, gl.pow_(w_full, int(jb)))
+        vals.append(gl.inv(gl.sub(gl.pow_(shift, n), 1)))
+    per_coset = jnp.asarray(np.array(vals, dtype=np.uint64))
+    return jnp.repeat(per_coset, n)
+
+
+def prove(assembly, setup, config: ProofConfig) -> Proof:
+    if assembly.lookup_params.is_enabled or assembly.lookup_rows:
+        raise NotImplementedError("lookup argument not wired into prover yet")
+    n = assembly.trace_len
+    log_n = n.bit_length() - 1
+    L = config.fri_lde_factor
+    log_full = log_n + (L.bit_length() - 1)
+    N = n * L
+    cap = config.merkle_tree_cap_size
+    geometry = assembly.geometry
+    C = assembly.copy_placement.shape[0]
+    W = assembly.wit_placement.shape[0]
+    K = geometry.num_constant_columns
+
+    t = Poseidon2Transcript()
+    t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
+    pi_values = [v for (_c, _r, v) in assembly.public_inputs]
+    t.witness_field_elements(pi_values)
+
+    # ---- round 1: witness commitment -------------------------------------
+    copy_vals = jnp.asarray(assembly.copy_cols_values)
+    cols = [copy_vals]
+    if W:
+        cols.append(jnp.asarray(assembly.wit_cols_values))
+    witness_cols = jnp.concatenate(cols, axis=0) if len(cols) > 1 else cols[0]
+    wit_mono = monomial_from_values(witness_cols)
+    wit_lde = lde_from_monomial(wit_mono, L)  # (C+W, L, n)
+    wit_tree, _ = _commit_columns(wit_lde, cap)
+    t.witness_merkle_tree_cap(wit_tree.get_cap())
+    beta = t.get_ext_challenge()
+    gamma = t.get_ext_challenge()
+
+    # ---- round 2: copy-permutation stage 2 -------------------------------
+    sigma_dev = jnp.asarray(setup.sigma_cols)
+    z, partials, chunks = compute_copy_permutation_stage2(
+        copy_vals, sigma_dev, setup.non_residues, beta, gamma,
+        geometry.max_allowed_constraint_degree,
+    )
+    stage2_cols = jnp.stack(
+        [z[0], z[1]] + [c for p in partials for c in (p[0], p[1])]
+    )
+    s2_mono = monomial_from_values(stage2_cols)
+    s2_lde = lde_from_monomial(s2_mono, L)
+    s2_tree, _ = _commit_columns(s2_lde, cap)
+    t.witness_merkle_tree_cap(s2_tree.get_cap())
+    alpha = t.get_ext_challenge()
+
+    # ---- round 3: quotient -----------------------------------------------
+    copy_lde_flat = wit_lde[:C].reshape(C, N)
+    wit_lde_flat = wit_lde[C:].reshape(W, N) if W else None
+    setup_lde_flat = setup.setup_lde.reshape(C + K, N)
+    sigma_lde_flat = setup_lde_flat[:C]
+    const_lde_flat = setup_lde_flat[C:]
+    xs_lde = _domain_xs_brev(log_n, L)
+    # L_0(x) = (x^n - 1) / (n (x - 1))
+    zh = gf.sub(
+        jnp.repeat(
+            jnp.asarray(
+                np.array(
+                    [
+                        gl.pow_(
+                            gl.mul(
+                                gl.MULTIPLICATIVE_GENERATOR,
+                                gl.pow_(gl.omega(log_full), int(jb)),
+                            ),
+                            n,
+                        )
+                        for jb in bitreverse_indices(L.bit_length() - 1)
+                    ],
+                    dtype=np.uint64,
+                )
+            ),
+            n,
+        ),
+        jnp.uint64(1),
+    )
+    l0 = gf.mul(
+        gf.mul(zh, jnp.uint64(gl.inv(n))),
+        gf.batch_inverse(gf.sub(xs_lde, jnp.uint64(1))),
+    )
+    z_lde = tuple(
+        lde_from_monomial(s2_mono[i], L).reshape(N) for i in (0, 1)
+    )
+    omega = gl.omega(log_n)
+    z_shift_mono = (
+        distribute_powers(s2_mono[0], omega),
+        distribute_powers(s2_mono[1], omega),
+    )
+    z_shift_lde = tuple(
+        lde_from_monomial(z_shift_mono[i], L).reshape(N) for i in (0, 1)
+    )
+    partial_ldes = []
+    for j in range(len(partials)):
+        p_lde = tuple(
+            lde_from_monomial(s2_mono[2 + 2 * j + i], L).reshape(N)
+            for i in (0, 1)
+        )
+        partial_ldes.append(p_lde)
+
+    alpha_iter = alpha_powers_iter(alpha)
+    acc = gate_terms_contribution(
+        assembly, setup.selector_paths, copy_lde_flat, wit_lde_flat,
+        const_lde_flat, setup.selector_depth, alpha_iter, (N,),
+    )
+    cp_acc = copy_permutation_quotient_terms(
+        z_lde, z_shift_lde, partial_ldes, chunks, copy_lde_flat,
+        sigma_lde_flat, setup.non_residues, xs_lde, l0, beta, gamma,
+        alpha_iter,
+    )
+    acc = cp_acc if acc is None else ext_f.add(acc, cp_acc)
+    zh_inv = _vanishing_inv_brev(log_n, L)
+    T = (gf.mul(acc[0], zh_inv), gf.mul(acc[1], zh_inv))
+    # interpolate over the full LDE coset to monomial form
+    g_inv = gl.inv(gl.MULTIPLICATIVE_GENERATOR)
+    T_mono = tuple(
+        distribute_powers(ifft_bitreversed_to_natural(T[i]), g_inv)
+        for i in (0, 1)
+    )
+    # split into L chunks of degree < n, interleave (c0, c1)
+    q_cols = []
+    for i in range(L):
+        for comp in (0, 1):
+            q_cols.append(T_mono[comp][i * n : (i + 1) * n])
+    q_mono = jnp.stack(q_cols)  # (2L, n) already monomial
+    q_lde = lde_from_monomial(q_mono, L)
+    q_tree, _ = _commit_columns(q_lde, cap)
+    t.witness_merkle_tree_cap(q_tree.get_cap())
+    z_chal = t.get_ext_challenge()
+
+    # ---- round 4: evaluations at z ---------------------------------------
+    all_mono = jnp.concatenate([wit_mono, setup.setup_monomials, s2_mono, q_mono])
+    B = all_mono.shape[0]
+    z_pows = ext_powers_device(z_chal, n)
+    ev0, ev1 = eval_monomial_at_ext_point(all_mono, z_chal, z_pows)
+    values_at_z = [
+        (int(a), int(b)) for a, b in zip(np.asarray(ev0), np.asarray(ev1))
+    ]
+    zw = ext_f.mul_by_base_s(z_chal, omega)
+    zw_pows = ext_powers_device(zw, n)
+    evw0, evw1 = eval_monomial_at_ext_point(s2_mono[:2], zw, zw_pows)
+    values_at_z_omega = [
+        (int(a), int(b)) for a, b in zip(np.asarray(evw0), np.asarray(evw1))
+    ]
+    for v in values_at_z:
+        t.witness_field_elements(v)
+    for v in values_at_z_omega:
+        t.witness_field_elements(v)
+    deep_ch = t.get_ext_challenge()
+
+    # ---- round 5: DEEP + FRI ---------------------------------------------
+    all_lde_flat = jnp.concatenate(
+        [
+            wit_lde.reshape(C + W, N),
+            setup_lde_flat,
+            s2_lde.reshape(-1, N),
+            q_lde.reshape(2 * L, N),
+        ]
+    )
+    # 1/(x - z), 1/(x - z*omega) over the domain (ext)
+    x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),
+                 jnp.broadcast_to(jnp.uint64(gl.neg(z_chal[1])), xs_lde.shape))
+    inv_xz = ext_f.batch_inverse(x_minus_z)
+    x_minus_zw = (gf.sub(xs_lde, jnp.uint64(zw[0])),
+                  jnp.broadcast_to(jnp.uint64(gl.neg(zw[1])), xs_lde.shape))
+    inv_xzw = ext_f.batch_inverse(x_minus_zw)
+
+    h = None
+    ch_iter = alpha_powers_iter(deep_ch)
+    for i in range(B):
+        ch = ext_scalar(next(ch_iter))
+        y = values_at_z[i]
+        num = (
+            gf.sub(all_lde_flat[i], jnp.uint64(y[0])),
+            jnp.broadcast_to(jnp.uint64(gl.neg(y[1])), xs_lde.shape),
+        )
+        term = ext_f.mul(ext_f.mul(num, inv_xz), ch)
+        h = term if h is None else ext_f.add(h, term)
+    # z-poly at z*omega
+    s2_flat = s2_lde.reshape(-1, N)
+    for i in range(2):
+        ch = ext_scalar(next(ch_iter))
+        y = values_at_z_omega[i]
+        num = (
+            gf.sub(s2_flat[i], jnp.uint64(y[0])),
+            jnp.broadcast_to(jnp.uint64(gl.neg(y[1])), xs_lde.shape),
+        )
+        term = ext_f.mul(ext_f.mul(num, inv_xzw), ch)
+        h = ext_f.add(h, term)
+    # public input openings: (w_col(x) - value) / (x - w^row)
+    if assembly.public_inputs:
+        pi_points = [gl.pow_(omega, r) for (_c, r, _v) in assembly.public_inputs]
+        denoms = gf.batch_inverse(
+            jnp.stack([gf.sub(xs_lde, jnp.uint64(p)) for p in pi_points])
+        )
+        for k, (col, _row, value) in enumerate(assembly.public_inputs):
+            ch = ext_scalar(next(ch_iter))
+            num = gf.sub(wit_lde.reshape(C + W, N)[col], jnp.uint64(value))
+            term_base = gf.mul(num, denoms[k])
+            h = ext_f.add(h, (gf.mul(term_base, ch[0]), gf.mul(term_base, ch[1])))
+
+    fri = fri_prove(h, t, config, base_degree=n)
+    pow_nonce = pow_grind(t, config.pow_bits)
+
+    # ---- queries ----------------------------------------------------------
+    bs = BitSource(log_full)
+    wit_leaves = wit_lde.reshape(C + W, N)
+    setup_leaves = setup_lde_flat
+    s2_leaves = s2_flat
+    q_leaves = q_lde.reshape(2 * L, N)
+    queries = []
+    for _ in range(config.num_queries):
+        idx = bs.get_index(t, log_full)
+        def oq(leaves_cols, tree, leaf_idx):
+            vals = [int(x) for x in np.asarray(leaves_cols[:, leaf_idx])]
+            return OracleQuery(leaf_values=vals, path=tree.get_proof(leaf_idx))
+        fri_qs = []
+        fidx = idx
+        for r, tree in enumerate(fri.trees):
+            pair = fidx >> 1
+            v = fri.values[r]
+            vals = [
+                int(np.asarray(v[0][2 * pair])),
+                int(np.asarray(v[1][2 * pair])),
+                int(np.asarray(v[0][2 * pair + 1])),
+                int(np.asarray(v[1][2 * pair + 1])),
+            ]
+            fri_qs.append(OracleQuery(leaf_values=vals, path=tree.get_proof(pair)))
+            fidx >>= 1
+        queries.append(
+            SingleRoundQueries(
+                witness=oq(wit_leaves, wit_tree, idx),
+                stage2=oq(s2_leaves, s2_tree, idx),
+                quotient=oq(q_leaves, q_tree, idx),
+                setup=oq(setup_leaves, setup.setup_tree, idx),
+                fri=fri_qs,
+            )
+        )
+
+    return Proof(
+        public_inputs=pi_values,
+        witness_cap=wit_tree.get_cap(),
+        stage2_cap=s2_tree.get_cap(),
+        quotient_cap=q_tree.get_cap(),
+        values_at_z=values_at_z,
+        values_at_z_omega=values_at_z_omega,
+        values_at_0=[],
+        fri_caps=[tr.get_cap() for tr in fri.trees],
+        final_fri_monomials=fri.final_monomials,
+        queries=queries,
+        pow_challenge=pow_nonce,
+        config={
+            "fri_lde_factor": L,
+            "merkle_tree_cap_size": cap,
+            "num_queries": config.num_queries,
+            "pow_bits": config.pow_bits,
+            "fri_final_degree": config.fri_final_degree,
+        },
+    )

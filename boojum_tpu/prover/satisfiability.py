@@ -1,0 +1,43 @@
+"""Per-row satisfiability checker (debug aid; reference
+satisfiability_test.rs:15 `check_if_satisfied`): re-evaluates every placed
+gate over scalar field values. Runs the SAME evaluators as prover/verifier via
+ScalarOps — a three-way cross-check of the field-like contract.
+"""
+
+from __future__ import annotations
+
+from ..cs.field_like import ScalarOps
+from ..cs.gates.base import RowView, TermsCollector
+
+
+def check_if_satisfied(assembly, verbose: bool = False) -> bool:
+    n = assembly.trace_len
+    geometry = assembly.geometry
+    copy_vals = assembly.copy_cols_values
+    wit_vals = assembly.wit_cols_values
+    for row in range(n):
+        gate = assembly.gates[int(assembly.row_gate[row])]
+        if gate.num_terms == 0:
+            continue
+        consts = assembly.gate_constants.get(row, ())
+        reps = gate.num_repetitions(geometry)
+        for inst in range(reps):
+            voff = inst * gate.principal_width
+            woff = inst * gate.witness_width
+
+            row_view = RowView(
+                lambda i, row=row, voff=voff: int(copy_vals[voff + i, row]),
+                lambda i, row=row, woff=woff: int(wit_vals[woff + i, row]),
+                lambda i, consts=consts: consts[i] if i < len(consts) else 0,
+            )
+            dst = TermsCollector()
+            gate.evaluate(ScalarOps, row_view, dst)
+            for ti, term in enumerate(dst.terms):
+                if term != 0:
+                    if verbose:
+                        print(
+                            f"UNSATISFIED: row {row} gate {gate.name} "
+                            f"instance {inst} term {ti} = {term}"
+                        )
+                    return False
+    return True

@@ -1,0 +1,235 @@
+"""Setup / verification-key generation.
+
+Counterpart of `/root/reference/src/cs/implementations/setup.rs`
+(`create_permutation_polys` :401, `compute_selectors_and_constants_placement`
+:486, `create_constant_setup_polys` :710, `get_full_setup` :1255).
+
+TPU-first differences:
+- sigma construction is a single vectorized numpy pass (stable argsort over
+  the flattened placement + per-group rotation), not a per-cell cycle walk;
+- selector encoding uses a balanced binary tree over the used gate set
+  (variable-depth optimization as in the reference's TreeNode comes later);
+  the path bits land in the leading constant columns, gate constants follow;
+- all setup polynomials are low-degree-extended and Merkle-committed on
+  device in one batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..merkle import MerkleTreeWithCap
+from ..ntt import lde_from_monomial, monomial_from_values
+
+
+def build_selector_paths(gates) -> list[list[int]]:
+    """Balanced binary tree over gate ids; path = LSB-first bit list."""
+    k = len(gates)
+    if k == 1:
+        return [[]]  # single gate: selector constantly 1
+    depth = (k - 1).bit_length()
+    return [[(i >> b) & 1 for b in range(depth)] for i in range(k)]
+
+
+def non_residues_for_copy_permutation(num_cols: int) -> list[int]:
+    """Distinct coset representatives k_col = g^col (g the multiplicative
+    generator); k_0 = 1 (reference utils.rs non-residues)."""
+    out = [1]
+    for _ in range(1, num_cols):
+        out.append(gl.mul(out[-1], gl.MULTIPLICATIVE_GENERATOR))
+    return out
+
+
+def compute_sigma_values(copy_placement: np.ndarray, trace_len: int):
+    """Vectorized permutation-polynomial construction.
+
+    copy_placement: (C, n) int64 of place ids (-1 vacant). Cells holding the
+    same variable form a cycle; sigma maps each cell to the next one in its
+    cycle (vacant cells are fixed points). Returns (C, n) uint64 of
+    sigma_col(w^row) = k_{col'} * w^{row'}.
+    """
+    C, n = copy_placement.shape
+    assert n == trace_len
+    pl = copy_placement.reshape(-1)
+    N = C * n
+    order = np.argsort(pl, kind="stable")
+    sorted_pl = pl[order]
+    pos = np.arange(N)
+    same_next = np.zeros(N, dtype=bool)
+    same_next[:-1] = sorted_pl[1:] == sorted_pl[:-1]
+    # group starts
+    first = np.ones(N, dtype=bool)
+    first[1:] = sorted_pl[1:] != sorted_pl[:-1]
+    group_id = np.cumsum(first) - 1
+    start_positions = np.nonzero(first)[0]
+    starts_per_pos = start_positions[group_id]
+    nxt = np.where(same_next, pos + 1, starts_per_pos)
+    sigma_cell = np.empty(N, dtype=np.int64)
+    sigma_cell[order] = order[nxt]
+    # vacant cells: identity
+    vacant = pl < 0
+    sigma_cell[vacant] = np.nonzero(vacant)[0]
+    # encode: cell -> k_col * w^row
+    omega = gl.omega(n.bit_length() - 1)
+    w_pows = np.zeros(n, dtype=np.uint64)
+    cur = 1
+    for i in range(n):
+        w_pows[i] = cur
+        cur = gl.mul(cur, omega)
+    ks = np.array(non_residues_for_copy_permutation(C), dtype=np.uint64)
+    tgt_col = (sigma_cell // n).astype(np.int64)
+    tgt_row = (sigma_cell % n).astype(np.int64)
+    # modmul on host via python objects is slow; use 128-bit numpy trick:
+    a = ks[tgt_col].astype(object)
+    b = w_pows[tgt_row].astype(object)
+    vals = (a * b) % gl.P
+    return np.array(vals, dtype=np.uint64).reshape(C, n)
+
+
+def build_constant_columns(assembly, selector_paths) -> np.ndarray:
+    """(K, n) uint64: selector path bits then per-gate constants."""
+    n = assembly.trace_len
+    K = assembly.geometry.num_constant_columns
+    depth = max((len(p) for p in selector_paths), default=0)
+    max_consts = max((g.num_constants for g in assembly.gates), default=0)
+    assert depth + max_consts <= K, (
+        f"selector depth {depth} + gate constants {max_consts} exceed "
+        f"{K} constant columns"
+    )
+    cols = np.zeros((K, n), dtype=np.uint64)
+    paths = np.array(
+        [p + [0] * (depth - len(p)) for p in selector_paths], dtype=np.uint64
+    ).reshape(len(selector_paths), max(depth, 1) if depth else 0)
+    rg = assembly.row_gate
+    if depth:
+        cols[:depth, :] = paths[rg].T
+    for row, consts in assembly.gate_constants.items():
+        for i, c in enumerate(consts):
+            cols[depth + i, row] = c
+    return cols
+
+
+@dataclass
+class VerificationKey:
+    """Fixed parameters + setup commitment (reference verifier.rs:31)."""
+
+    geometry: object
+    trace_len: int
+    fri_lde_factor: int
+    cap_size: int
+    num_queries: int
+    pow_bits: int
+    fri_final_degree: int
+    gate_names: list
+    selector_paths: list
+    public_input_locations: list  # [(col, row)]
+    setup_merkle_cap: list
+    num_copy_cols: int
+    num_wit_cols: int
+    lookup_params: object = None
+    num_lookup_tables: int = 0
+
+    def to_dict(self):
+        from dataclasses import asdict
+
+        d = {
+            "trace_len": self.trace_len,
+            "fri_lde_factor": self.fri_lde_factor,
+            "cap_size": self.cap_size,
+            "num_queries": self.num_queries,
+            "pow_bits": self.pow_bits,
+            "fri_final_degree": self.fri_final_degree,
+            "gate_names": list(self.gate_names),
+            "selector_paths": [list(p) for p in self.selector_paths],
+            "public_input_locations": list(self.public_input_locations),
+            "setup_merkle_cap": [list(c) for c in self.setup_merkle_cap],
+            "num_copy_cols": self.num_copy_cols,
+            "num_wit_cols": self.num_wit_cols,
+            "geometry": {
+                "num_columns_under_copy_permutation": self.geometry.num_columns_under_copy_permutation,
+                "num_witness_columns": self.geometry.num_witness_columns,
+                "num_constant_columns": self.geometry.num_constant_columns,
+                "max_allowed_constraint_degree": self.geometry.max_allowed_constraint_degree,
+            },
+        }
+        return d
+
+
+@dataclass
+class SetupData:
+    """Everything the prover needs beyond the assembly's witness."""
+
+    vk: VerificationKey
+    sigma_cols: np.ndarray  # (C, n) host
+    constant_cols: np.ndarray  # (K, n) host
+    setup_monomials: object  # (C+K, n) device
+    setup_lde: object  # (C+K, lde, n) device
+    setup_tree: MerkleTreeWithCap
+    selector_paths: list
+    non_residues: list
+    selector_depth: int
+
+
+def generate_setup(assembly, config) -> SetupData:
+    """Full setup: sigmas + constants -> monomial -> LDE -> Merkle -> VK."""
+    if assembly.lookup_params.is_enabled or assembly.lookup_rows:
+        raise NotImplementedError(
+            "lookup argument not wired into setup/prover yet; "
+            "do not use enforce_lookup/perform_lookup"
+        )
+    n = assembly.trace_len
+    selector_paths = build_selector_paths(assembly.gates)
+    # masked-constraint degree must fit the quotient LDE domain:
+    # (selector depth + gate degree) * (n-1) <= L*n - 1, conservatively
+    # depth + max_degree <= L; same cap for copy-permutation chunk relations.
+    depth_chk = max((len(p) for p in selector_paths), default=0)
+    for g in assembly.gates:
+        assert depth_chk + g.max_degree <= config.fri_lde_factor, (
+            f"gate {g.name}: selector depth {depth_chk} + degree "
+            f"{g.max_degree} exceeds fri_lde_factor {config.fri_lde_factor}"
+        )
+    assert (
+        assembly.geometry.max_allowed_constraint_degree + 1
+        <= config.fri_lde_factor
+    ), "copy-permutation chunk degree exceeds fri_lde_factor"
+    sigma = compute_sigma_values(assembly.copy_placement, n)
+    consts = build_constant_columns(assembly, selector_paths)
+    setup_cols = np.concatenate([sigma, consts], axis=0)
+    dev = jnp.asarray(setup_cols)
+    monomials = monomial_from_values(dev)
+    lde = lde_from_monomial(monomials, config.fri_lde_factor)
+    leaves = lde.reshape(lde.shape[0], -1).T  # (lde*n, C+K)
+    tree = MerkleTreeWithCap(leaves, config.merkle_tree_cap_size)
+    depth = max((len(p) for p in selector_paths), default=0)
+    vk = VerificationKey(
+        geometry=assembly.geometry,
+        trace_len=n,
+        fri_lde_factor=config.fri_lde_factor,
+        cap_size=config.merkle_tree_cap_size,
+        num_queries=config.num_queries,
+        pow_bits=config.pow_bits,
+        fri_final_degree=config.fri_final_degree,
+        gate_names=[g.name for g in assembly.gates],
+        selector_paths=selector_paths,
+        public_input_locations=[(c, r) for (c, r, _v) in assembly.public_inputs],
+        setup_merkle_cap=tree.get_cap(),
+        num_copy_cols=assembly.copy_placement.shape[0],
+        num_wit_cols=assembly.wit_placement.shape[0],
+        lookup_params=assembly.lookup_params,
+        num_lookup_tables=len(assembly.lookup_tables),
+    )
+    return SetupData(
+        vk=vk,
+        sigma_cols=sigma,
+        constant_cols=consts,
+        setup_monomials=monomials,
+        setup_lde=lde,
+        setup_tree=tree,
+        selector_paths=selector_paths,
+        non_residues=non_residues_for_copy_permutation(sigma.shape[0]),
+        selector_depth=depth,
+    )

@@ -1,0 +1,252 @@
+"""Prover stage computations: copy-permutation grand product and the
+gate-constraint quotient sweep.
+
+Counterparts: `/root/reference/src/cs/implementations/copy_permutation.rs`
+(pointwise rational accumulation :30, shifted grand product :367, partial
+products chunked by degree :525, quotient terms :1000) and the general-purpose
+gate sweep of `prover.rs:813-1130`.
+
+TPU-first shape: everything is computed on whole (…, n) or (…, lde·n) arrays;
+the grand product is ONE `jax.lax.associative_scan` over the row axis (the
+scan counterpart of the reference's chunked sequential products), and the gate
+sweep evaluates every allowed gate's evaluator over the entire LDE domain at
+once, masked by its selector-path polynomial — the "static masked evaluation"
+form that suits SIMD/MXU hardware.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import extension as ext_f
+from ..field import goldilocks as gf
+from ..ntt import (
+    bitreverse_indices,
+    get_ntt_context,
+    lde_from_monomial,
+    monomial_from_values,
+    powers_device,
+)
+from ..cs.field_like import ArrayOps
+from ..cs.gates.base import RowView, TermsCollector
+
+
+def ext_scalar(s):
+    return (jnp.uint64(int(s[0])), jnp.uint64(int(s[1])))
+
+
+def chunk_columns(num_cols: int, max_degree: int):
+    """Split copy columns into chunks of size <= max_degree (the relation
+    degree cap; reference copy_permutation.rs:525)."""
+    cs = max(1, max_degree)
+    return [list(range(i, min(i + cs, num_cols))) for i in range(0, num_cols, cs)]
+
+
+def compute_copy_permutation_stage2(
+    copy_vals, sigma_vals, non_residues, beta, gamma, max_degree
+):
+    """Grand product z and partial products over H.
+
+    copy_vals/sigma_vals: (C, n) device base arrays (natural row order);
+    beta/gamma host ext scalars. Returns (z_pair, partial_pairs, chunks)
+    where z(w^0)=1 and for the last chunk relation
+    z(w*x)·prod_den_last = p_last·prod_num_last holds.
+    """
+    C, n = copy_vals.shape
+    ctx = get_ntt_context(n.bit_length() - 1)
+    xs = powers_device(ctx.omega, n)  # w^r natural order
+    b = ext_scalar(beta)
+    g = ext_scalar(gamma)
+    chunks = chunk_columns(C, max_degree)
+    ks = [jnp.uint64(k) for k in non_residues]
+
+    def num_den_for_col(j):
+        w = copy_vals[j]
+        kx = gf.mul(xs, ks[j])
+        num = (
+            gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
+            gf.add(gf.mul(kx, b[1]), g[1]),
+        )
+        s = sigma_vals[j]
+        den = (
+            gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
+            gf.add(gf.mul(s, b[1]), g[1]),
+        )
+        return num, den
+
+    chunk_ratios = []
+    for chunk in chunks:
+        num_p = None
+        den_p = None
+        for j in chunk:
+            num, den = num_den_for_col(j)
+            num_p = num if num_p is None else ext_f.mul(num_p, num)
+            den_p = den if den_p is None else ext_f.mul(den_p, den)
+        ratio = ext_f.mul(num_p, ext_f.batch_inverse(den_p))
+        chunk_ratios.append(ratio)
+
+    full_ratio = chunk_ratios[0]
+    for r in chunk_ratios[1:]:
+        full_ratio = ext_f.mul(full_ratio, r)
+
+    # z = exclusive prefix product of full_ratio along rows
+    def emul(a, b):
+        return ext_f.mul(a, b)
+
+    incl = jax.lax.associative_scan(emul, full_ratio, axis=-1)
+    one = jnp.ones((1,), jnp.uint64)
+    zero = jnp.zeros((1,), jnp.uint64)
+    z = (
+        jnp.concatenate([one, incl[0][:-1]]),
+        jnp.concatenate([zero, incl[1][:-1]]),
+    )
+    # partial products p_j = z * prod_{k<=j} chunk_ratio_k (pointwise row r)
+    partials = []
+    acc = z
+    for r in chunk_ratios[:-1]:
+        acc = ext_f.mul(acc, r)
+        partials.append(acc)
+    return z, partials, chunks
+
+
+class LdeRowView:
+    """RowView over flattened LDE arrays for one gate-instance chunk."""
+
+    def __init__(self, copy_lde_flat, wit_lde_flat, const_lde_flat, var_off, wit_off, const_off):
+        self._c = copy_lde_flat
+        self._w = wit_lde_flat
+        self._k = const_lde_flat
+        self._vo = var_off
+        self._wo = wit_off
+        self._ko = const_off
+
+    def v(self, i):
+        return self._c[self._vo + i]
+
+    def w(self, i):
+        return self._w[self._wo + i]
+
+    def c(self, i):
+        return self._k[self._ko + i]
+
+
+def selector_poly_lde(const_lde_flat, path):
+    """Product over path bits of c_b or (1 - c_b), over the LDE domain."""
+    sel = None
+    one = jnp.uint64(1)
+    for b, bit in enumerate(path):
+        col = const_lde_flat[b]
+        f = col if bit else gf.sub(jnp.broadcast_to(one, col.shape), col)
+        sel = f if sel is None else gf.mul(sel, f)
+    return sel  # None = constant 1 (single-gate circuits)
+
+
+def alpha_powers_iter(alpha):
+    """Infinite iterator of host ext powers 1, a, a^2, ..."""
+    cur = ext_f.ONE_S
+    a = (int(alpha[0]), int(alpha[1]))
+    while True:
+        yield cur
+        cur = ext_f.mul_s(cur, a)
+
+
+def accumulate_ext(acc, term_base, challenge):
+    """acc += challenge * term for base-field term arrays, ext challenge."""
+    ch = ext_scalar(challenge)
+    t0 = gf.mul(term_base, ch[0])
+    t1 = gf.mul(term_base, ch[1])
+    if acc is None:
+        return (t0, t1)
+    return (gf.add(acc[0], t0), gf.add(acc[1], t1))
+
+
+def accumulate_ext_ext(acc, term_ext, challenge):
+    ch = ext_scalar(challenge)
+    t = ext_f.mul(term_ext, ch)
+    if acc is None:
+        return t
+    return ext_f.add(acc, t)
+
+
+def gate_terms_contribution(
+    assembly, selector_paths, copy_lde_flat, wit_lde_flat, const_lde_flat,
+    selector_depth, alpha_iter, domain_shape,
+):
+    """Sum over gates/instances/terms of alpha^t * selector_g * term."""
+    geometry = assembly.geometry
+    acc = None
+    for gid, gate in enumerate(assembly.gates):
+        if gate.num_terms == 0:
+            continue
+        path = selector_paths[gid]
+        sel = selector_poly_lde(const_lde_flat, path)
+        reps = gate.num_repetitions(geometry)
+        gate_acc = None
+        for inst in range(reps):
+            row = LdeRowView(
+                copy_lde_flat,
+                wit_lde_flat,
+                const_lde_flat,
+                inst * gate.principal_width,
+                inst * gate.witness_width,
+                selector_depth,
+            )
+            dst = TermsCollector()
+            gate.evaluate(ArrayOps, row, dst)
+            assert len(dst.terms) == gate.num_terms, gate.name
+            for term in dst.terms:
+                gate_acc = accumulate_ext(gate_acc, term, next(alpha_iter))
+        if gate_acc is not None:
+            if sel is not None:
+                gate_acc = (gf.mul(gate_acc[0], sel), gf.mul(gate_acc[1], sel))
+            acc = gate_acc if acc is None else ext_f.add(acc, gate_acc)
+    return acc
+
+
+def copy_permutation_quotient_terms(
+    z_lde, z_shift_lde, partial_ldes, chunks, copy_lde, sigma_lde,
+    non_residues, xs_lde, l0_lde, beta, gamma, alpha_iter,
+):
+    """Quotient contributions of the copy-permutation argument over the LDE
+    domain (reference copy_permutation.rs:1000):
+
+      t0: L_0(x) · (z(x) − 1)
+      per chunk j:  lhs_j(x)·prod_den_j(x) − rhs_j(x)·prod_num_j(x)
+        where (lhs, rhs) walk z, p_0, …, p_last, z(w·x).
+    """
+    b = ext_scalar(beta)
+    g = ext_scalar(gamma)
+    one = jnp.uint64(1)
+    acc = None
+    # L_0(x)(z(x)-1)
+    zm1 = (gf.sub(z_lde[0], jnp.broadcast_to(one, z_lde[0].shape)), z_lde[1])
+    t0 = (gf.mul(zm1[0], l0_lde), gf.mul(zm1[1], l0_lde))
+    acc = accumulate_ext_ext(acc, t0, next(alpha_iter))
+    lhs_seq = partial_ldes + [z_shift_lde]
+    rhs_seq = [z_lde] + partial_ldes
+    ks = non_residues
+    for j, chunk in enumerate(chunks):
+        num_p = None
+        den_p = None
+        for col in chunk:
+            w = copy_lde[col]
+            kx = gf.mul(xs_lde, jnp.uint64(ks[col]))
+            num = (
+                gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
+                gf.add(gf.mul(kx, b[1]), g[1]),
+            )
+            s = sigma_lde[col]
+            den = (
+                gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
+                gf.add(gf.mul(s, b[1]), g[1]),
+            )
+            num_p = num if num_p is None else ext_f.mul(num_p, num)
+            den_p = den if den_p is None else ext_f.mul(den_p, den)
+        term = ext_f.sub(
+            ext_f.mul(lhs_seq[j], den_p), ext_f.mul(rhs_seq[j], num_p)
+        )
+        acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+    return acc

@@ -1,0 +1,303 @@
+"""Plain (host) verifier.
+
+Counterpart of `/root/reference/src/cs/implementations/verifier.rs:888`:
+transcript replay, quotient reconstruction at z via the same gate evaluators
+(over ExtScalarOps — the verifier-side face of the field-like contract),
+copy-permutation relations at z, and DEEP/FRI query checking against Merkle
+caps. Pure python ints: the verifier is tiny compared to proving and needs no
+device.
+"""
+
+from __future__ import annotations
+
+from ..field import gl
+from ..field import extension as ext_f
+from ..merkle import verify_proof_over_cap
+from ..transcript import BitSource, Poseidon2Transcript
+from ..cs.field_like import ExtScalarOps
+from ..cs.gates.base import RowView, TermsCollector
+from .fri import fri_verify_queries, INV2
+from .pow import pow_verify
+from .stages import chunk_columns
+from .setup import non_residues_for_copy_permutation
+
+W_EXT = (0, 1)  # the extension generator (sqrt of 7)
+
+
+class _ZRowView:
+    """RowView over values-at-z for one gate instance chunk."""
+
+    def __init__(self, wit_vals, const_vals, var_off, wit_off, const_off, num_copy):
+        self.wit_vals = wit_vals
+        self.const_vals = const_vals
+        self.var_off = var_off
+        self.wit_off = wit_off
+        self.const_off = const_off
+        self.num_copy = num_copy
+
+    def v(self, i):
+        return self.wit_vals[self.var_off + i]
+
+    def w(self, i):
+        return self.wit_vals[self.num_copy + self.wit_off + i]
+
+    def c(self, i):
+        return self.const_vals[self.const_off + i]
+
+
+def ext_from_pair(c0, c1):
+    """Value of an ext-coefficient poly from its two base-poly openings."""
+    return ext_f.add_s(c0, ext_f.mul_s(c1, W_EXT))
+
+
+def verify(vk, proof, gates) -> bool:
+    geometry = vk.geometry
+    n = vk.trace_len
+    log_n = n.bit_length() - 1
+    L = vk.fri_lde_factor
+    log_full = log_n + (L.bit_length() - 1)
+    N = n * L
+    C = vk.num_copy_cols
+    W = vk.num_wit_cols
+    K = geometry.num_constant_columns
+    if [g.name for g in gates] != list(vk.gate_names):
+        return False
+    if len(proof.public_inputs) != len(vk.public_input_locations):
+        return False
+
+    num_chunks = len(chunk_columns(C, geometry.max_allowed_constraint_degree))
+    S = 2 * (1 + (num_chunks - 1))  # z + partials, 2 base cols each
+    B = (C + W) + (C + K) + S + 2 * L
+    if len(proof.values_at_z) != B or len(proof.values_at_z_omega) != 2:
+        return False
+
+    # ---- transcript replay ------------------------------------------------
+    t = Poseidon2Transcript()
+    t.witness_merkle_tree_cap(vk.setup_merkle_cap)
+    t.witness_field_elements(proof.public_inputs)
+    t.witness_merkle_tree_cap(proof.witness_cap)
+    beta = t.get_ext_challenge()
+    gamma = t.get_ext_challenge()
+    t.witness_merkle_tree_cap(proof.stage2_cap)
+    alpha = t.get_ext_challenge()
+    t.witness_merkle_tree_cap(proof.quotient_cap)
+    z_chal = t.get_ext_challenge()
+    for v in proof.values_at_z:
+        t.witness_field_elements(v)
+    for v in proof.values_at_z_omega:
+        t.witness_field_elements(v)
+    deep_ch = t.get_ext_challenge()
+    # FRI replay — ALL security parameters come from the VK, never the proof
+    final_degree = vk.fri_final_degree
+    deg = n
+    num_folds = 0
+    while deg > final_degree:
+        deg //= 2
+        num_folds += 1
+    if len(proof.fri_caps) != num_folds:
+        return False
+    fri_challenges = []
+    for r in range(num_folds):
+        if r < len(proof.fri_caps):
+            t.witness_merkle_tree_cap(proof.fri_caps[r])
+        fri_challenges.append(t.get_ext_challenge())
+    # reorder: caps are absorbed before each challenge; prover absorbs cap r
+    # then draws challenge r, commits cap r+1 from the fold, etc.
+    if len(proof.final_fri_monomials) != (n >> num_folds):
+        return False
+    for c0, c1 in proof.final_fri_monomials:
+        t.witness_field_elements([c0, c1])
+
+    # ---- split openings ---------------------------------------------------
+    vals = [tuple(v) for v in proof.values_at_z]
+    wit_vals = vals[: C + W]
+    sigma_vals = vals[C + W : C + W + C]
+    const_vals = vals[C + W + C : C + W + C + K]
+    s2_vals = vals[C + W + C + K : C + W + C + K + S]
+    q_vals = vals[C + W + C + K + S :]
+
+    # ---- quotient identity at z ------------------------------------------
+    alpha_pows = _powers_iter(alpha)
+    total = ExtScalarOps.zero()
+    for gid, gate in enumerate(gates):
+        if gate.num_terms == 0:
+            continue
+        path = vk.selector_paths[gid]
+        sel = ExtScalarOps.one()
+        for b, bit in enumerate(path):
+            cb = const_vals[b]
+            sel = ext_f.mul_s(sel, cb if bit else ext_f.sub_s((1, 0), cb))
+        depth = max(len(p) for p in vk.selector_paths)
+        reps = gate.num_repetitions(geometry)
+        gate_acc = ExtScalarOps.zero()
+        for inst in range(reps):
+            row = _ZRowView(
+                wit_vals, const_vals, inst * gate.principal_width,
+                inst * gate.witness_width, depth, C,
+            )
+            dst = TermsCollector()
+            gate.evaluate(ExtScalarOps, row, dst)
+            if len(dst.terms) != gate.num_terms:
+                return False
+            for term in dst.terms:
+                gate_acc = ext_f.add_s(
+                    gate_acc, ext_f.mul_s(term, next(alpha_pows))
+                )
+        total = ext_f.add_s(total, ext_f.mul_s(sel, gate_acc))
+
+    # copy-permutation terms at z
+    z_at_z = ext_from_pair(s2_vals[0], s2_vals[1])
+    z_at_zw = ext_from_pair(
+        tuple(proof.values_at_z_omega[0]), tuple(proof.values_at_z_omega[1])
+    )
+    partial_at_z = [
+        ext_from_pair(s2_vals[2 + 2 * j], s2_vals[3 + 2 * j])
+        for j in range(num_chunks - 1)
+    ]
+    non_residues = non_residues_for_copy_permutation(C)
+    chunks = chunk_columns(C, geometry.max_allowed_constraint_degree)
+    # L_0(z) = (z^n - 1)/(n (z - 1))
+    z_pow_n = ext_f.pow_s(z_chal, n)
+    zh_at_z = ext_f.sub_s(z_pow_n, ext_f.ONE_S)
+    l0_at_z = ext_f.mul_s(
+        ext_f.mul_s(zh_at_z, (gl.inv(n), 0)),
+        ext_f.inv_s(ext_f.sub_s(z_chal, ext_f.ONE_S)),
+    )
+    term = ext_f.mul_s(l0_at_z, ext_f.sub_s(z_at_z, ext_f.ONE_S))
+    total = ext_f.add_s(total, ext_f.mul_s(term, next(alpha_pows)))
+    lhs_seq = partial_at_z + [z_at_zw]
+    rhs_seq = [z_at_z] + partial_at_z
+    for j, chunk in enumerate(chunks):
+        num_p = ext_f.ONE_S
+        den_p = ext_f.ONE_S
+        for col in chunk:
+            w = wit_vals[col]
+            kx = ext_f.mul_by_base_s(z_chal, non_residues[col])
+            num = ext_f.add_s(ext_f.add_s(w, ext_f.mul_s(beta, kx)), gamma)
+            den = ext_f.add_s(
+                ext_f.add_s(w, ext_f.mul_s(beta, sigma_vals[col])), gamma
+            )
+            num_p = ext_f.mul_s(num_p, num)
+            den_p = ext_f.mul_s(den_p, den)
+        rel = ext_f.sub_s(
+            ext_f.mul_s(lhs_seq[j], den_p), ext_f.mul_s(rhs_seq[j], num_p)
+        )
+        total = ext_f.add_s(total, ext_f.mul_s(rel, next(alpha_pows)))
+
+    # T(z) from quotient chunks: sum z^{i n} * q_i(z)
+    t_at_z = ext_f.ZERO_S
+    for i in range(L):
+        q_i = ext_from_pair(q_vals[2 * i], q_vals[2 * i + 1])
+        t_at_z = ext_f.add_s(
+            t_at_z, ext_f.mul_s(q_i, ext_f.pow_s(z_chal, i * n))
+        )
+    if total != ext_f.mul_s(t_at_z, zh_at_z):
+        return False
+
+    # ---- PoW + queries ----------------------------------------------------
+    if not pow_verify(t, vk.pow_bits, proof.pow_challenge):
+        return False
+    if len(proof.queries) != vk.num_queries:
+        return False
+    omega = gl.omega(log_n)
+    zw = ext_f.mul_by_base_s(z_chal, omega)
+    pi_locs = vk.public_input_locations
+    bs = BitSource(log_full)
+    for q in proof.queries:
+        idx = bs.get_index(t, log_full)
+        # oracle membership
+        if not verify_proof_over_cap(
+            q.witness.leaf_values, q.witness.path, proof.witness_cap, idx
+        ):
+            return False
+        if not verify_proof_over_cap(
+            q.stage2.leaf_values, q.stage2.path, proof.stage2_cap, idx
+        ):
+            return False
+        if not verify_proof_over_cap(
+            q.quotient.leaf_values, q.quotient.path, proof.quotient_cap, idx
+        ):
+            return False
+        if not verify_proof_over_cap(
+            q.setup.leaf_values, q.setup.path, vk.setup_merkle_cap, idx
+        ):
+            return False
+        if (
+            len(q.witness.leaf_values) != C + W
+            or len(q.setup.leaf_values) != C + K
+            or len(q.stage2.leaf_values) != S
+            or len(q.quotient.leaf_values) != 2 * L
+        ):
+            return False
+        # recompute the DEEP codeword value h(x) at the queried point
+        x = gl.mul(
+            gl.MULTIPLICATIVE_GENERATOR, gl.pow_(gl.omega(log_full), _brev(idx, log_full))
+        )
+        f_all = (
+            [ (v, 0) for v in q.witness.leaf_values ]
+            + [ (v, 0) for v in q.setup.leaf_values ]
+            + [ (v, 0) for v in q.stage2.leaf_values ]
+            + [ (v, 0) for v in q.quotient.leaf_values ]
+        )
+        inv_xz = ext_f.inv_s(ext_f.sub_s((x, 0), z_chal))
+        inv_xzw = ext_f.inv_s(ext_f.sub_s((x, 0), zw))
+        h = ext_f.ZERO_S
+        ch_iter = _powers_iter(deep_ch)
+        for i in range(B):
+            diff = ext_f.sub_s(f_all[i], vals[i])
+            h = ext_f.add_s(
+                h, ext_f.mul_s(ext_f.mul_s(diff, inv_xz), next(ch_iter))
+            )
+        for i in range(2):
+            f = (q.stage2.leaf_values[i], 0)
+            diff = ext_f.sub_s(f, tuple(proof.values_at_z_omega[i]))
+            h = ext_f.add_s(
+                h, ext_f.mul_s(ext_f.mul_s(diff, inv_xzw), next(ch_iter))
+            )
+        for k, (col, row) in enumerate(pi_locs):
+            ch = next(ch_iter)
+            pt = gl.pow_(omega, row)
+            diff = gl.sub(q.witness.leaf_values[col], proof.public_inputs[k])
+            tb = gl.mul(diff, gl.inv(gl.sub(x, pt)))
+            h = ext_f.add_s(h, ext_f.mul_by_base_s(ch, tb))
+        # FRI chain
+        if len(q.fri) != num_folds:
+            return False
+        pairs = []
+        fidx = idx
+        for r, oq in enumerate(q.fri):
+            pair_idx = fidx >> 1
+            if not verify_proof_over_cap(
+                oq.leaf_values, oq.path, proof.fri_caps[r], pair_idx
+            ):
+                return False
+            even = (oq.leaf_values[0], oq.leaf_values[1])
+            odd = (oq.leaf_values[2], oq.leaf_values[3])
+            pairs.append((even, odd))
+            fidx >>= 1
+        # base oracle value must equal recomputed h
+        base_even, base_odd = pairs[0]
+        mine = base_even if (idx & 1) == 0 else base_odd
+        if tuple(mine) != tuple(h):
+            return False
+        if not fri_verify_queries(
+            None, fri_challenges, [tuple(c) for c in proof.final_fri_monomials],
+            idx, pairs, log_full, num_folds,
+        ):
+            return False
+    return True
+
+
+def _powers_iter(a):
+    cur = ext_f.ONE_S
+    aa = (int(a[0]), int(a[1]))
+    while True:
+        yield cur
+        cur = ext_f.mul_s(cur, aa)
+
+
+def _brev(i: int, bits: int) -> int:
+    out = 0
+    for b in range(bits):
+        out |= ((i >> b) & 1) << (bits - 1 - b)
+    return out

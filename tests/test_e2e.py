@@ -1,0 +1,127 @@
+"""End-to-end prove -> verify on toy circuits (reference test model:
+prove_sha256-style full-pipeline runs, sha256/mod.rs:296)."""
+
+import numpy as np
+
+from boojum_tpu.cs.types import CSGeometry
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.cs.gates import (
+    BooleanConstraintGate,
+    ConstantsAllocatorGate,
+    FmaGate,
+    PublicInputGate,
+    SelectionGate,
+)
+from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+from boojum_tpu.prover.proof import Proof
+from boojum_tpu.field import gl
+
+GEOM = CSGeometry(
+    num_columns_under_copy_permutation=8,
+    num_witness_columns=0,
+    num_constant_columns=6,
+    max_allowed_constraint_degree=4,
+)
+
+CONFIG = ProofConfig(
+    fri_lde_factor=8,
+    merkle_tree_cap_size=4,
+    num_queries=20,
+    pow_bits=0,
+    fri_final_degree=4,
+)
+
+
+def build_fibonacci_circuit(steps=40, with_public_input=True):
+    """Fibonacci-ish chain: x_{i+1} = x_i * x_{i-1} + x_i, mixed with
+    booleans and selects; exposes the final value as a public input."""
+    cs = ConstraintSystem(GEOM, 1 << 10)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    flag = cs.alloc_variable_with_value(1)
+    BooleanConstraintGate.enforce(cs, flag)
+    for _ in range(steps):
+        c = FmaGate.fma(cs, a, b, a, 1, 1)
+        a, b = b, c
+    sel = SelectionGate.select(cs, flag, a, b)
+    if with_public_input:
+        PublicInputGate.place(cs, sel)
+    return cs, sel
+
+
+def test_e2e_prove_verify():
+    cs, out_var = build_fibonacci_circuit()
+    expected = cs.get_value(out_var)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+    setup = generate_setup(asm, CONFIG)
+    proof = prove(asm, setup, CONFIG)
+    assert proof.public_inputs == [expected]
+    gates = asm.gates
+    assert verify(setup.vk, proof, gates), "honest proof must verify"
+
+
+def test_e2e_rejects_tampering():
+    cs, _ = build_fibonacci_circuit(steps=10)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    proof = prove(asm, setup, CONFIG)
+    gates = asm.gates
+    assert verify(setup.vk, proof, gates)
+    # tamper public input
+    p2 = Proof.from_json(proof.to_json())
+    p2.public_inputs[0] = (p2.public_inputs[0] + 1) % gl.P
+    assert not verify(setup.vk, p2, gates)
+    # tamper an opened value
+    p3 = Proof.from_json(proof.to_json())
+    v = list(p3.values_at_z[3])
+    v[0] = (v[0] + 1) % gl.P
+    p3.values_at_z[3] = tuple(v)
+    assert not verify(setup.vk, p3, gates)
+    # tamper a cap
+    p4 = Proof.from_json(proof.to_json())
+    c = list(p4.witness_cap[0])
+    c[0] = (c[0] + 1) % gl.P
+    p4.witness_cap[0] = tuple(c)
+    assert not verify(setup.vk, p4, gates)
+    # tamper FRI final monomials
+    p5 = Proof.from_json(proof.to_json())
+    m = list(p5.final_fri_monomials[0])
+    m[0] = (m[0] + 1) % gl.P
+    p5.final_fri_monomials[0] = tuple(m)
+    assert not verify(setup.vk, p5, gates)
+
+
+def test_empty_queries_rejected():
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    proof = prove(asm, setup, CONFIG)
+    p = Proof.from_json(proof.to_json())
+    p.queries = []
+    assert not verify(setup.vk, p, asm.gates)
+
+
+def test_pow_grinding():
+    cfg = ProofConfig(
+        fri_lde_factor=8, merkle_tree_cap_size=4, num_queries=4,
+        pow_bits=4, fri_final_degree=4,
+    )
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, cfg)
+    proof = prove(asm, setup, cfg)
+    assert verify(setup.vk, proof, asm.gates)
+    bad = Proof.from_json(proof.to_json())
+    bad.pow_challenge += 1
+    assert not verify(setup.vk, bad, asm.gates)
+
+
+def test_proof_json_roundtrip():
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    proof = prove(asm, setup, CONFIG)
+    p2 = Proof.from_json(proof.to_json())
+    assert verify(setup.vk, p2, asm.gates)
