@@ -1,0 +1,80 @@
+"""Headline benchmark. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Current headline: full e2e proof wall-clock on the toy arithmetic circuit
+(until the SHA-256 gadget circuit lands, after which this switches to the
+reference bench geometry: 2^16 rows, 60 copy cols, lookups — BASELINE.md).
+vs_baseline is wall-clock speedup vs the most recent recorded run in
+BENCH_BASELINE.json if present, else 1.0.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+
+    geom = CSGeometry(
+        num_columns_under_copy_permutation=16,
+        num_witness_columns=0,
+        num_constant_columns=6,
+        max_allowed_constraint_degree=4,
+    )
+    config = ProofConfig(
+        fri_lde_factor=8,
+        merkle_tree_cap_size=16,
+        num_queries=50,
+        pow_bits=0,
+        fri_final_degree=16,
+    )
+    log_n = int(os.environ.get("BENCH_LOG_N", "10"))
+    cs = ConstraintSystem(geom, 1 << log_n)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    # fill ~full trace with FMA chains
+    per_row = FmaGate.instance().num_repetitions(geom)
+    steps = ((1 << log_n) - 8) * per_row
+    for _ in range(steps):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, config)
+
+    # warm-up (compile) then timed runs
+    proof = prove(asm, setup, config)
+    assert verify(setup.vk, proof, asm.gates)
+    t0 = time.perf_counter()
+    reps = 1
+    for _ in range(reps):
+        proof = prove(asm, setup, config)
+    wall = (time.perf_counter() - t0) / reps
+
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    vs = 1.0
+    if os.path.exists(base_path):
+        try:
+            base = json.load(open(base_path))
+            if base.get("metric") == f"fma_2^{log_n}_prove_wall" and base.get("value"):
+                vs = base["value"] / wall
+        except Exception:
+            pass
+    print(json.dumps({
+        "metric": f"fma_2^{log_n}_prove_wall",
+        "value": round(wall, 4),
+        "unit": "s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -62,11 +62,17 @@ class NTTContext:
         self.n = 1 << log_n
         self.omega = gl.omega(log_n)
         self.omega_inv = gl.inv(self.omega)
-        self.n_inv = jnp.uint64(gl.inv(self.n))
         half = max(self.n // 2, 1)
-        self.tw = powers_device(self.omega, half) if self.n > 1 else None
-        self.itw = powers_device(self.omega_inv, half) if self.n > 1 else None
-        self.brev = jnp.asarray(bitreverse_indices(log_n))
+        # contexts are cached across jit traces (lru_cache below): build the
+        # tables eagerly even if first touched inside a trace, or the cached
+        # arrays would be leaked tracers
+        with jax.ensure_compile_time_eval():
+            self.n_inv = jnp.uint64(gl.inv(self.n))
+            self.tw = powers_device(self.omega, half) if self.n > 1 else None
+            self.itw = (
+                powers_device(self.omega_inv, half) if self.n > 1 else None
+            )
+            self.brev = jnp.asarray(bitreverse_indices(log_n))
 
 
 @lru_cache(maxsize=None)

@@ -1,0 +1,6 @@
+from .sharding import (
+    make_mesh,
+    sharded_prove_fragment,
+    col_sharding,
+    leaf_sharding,
+)

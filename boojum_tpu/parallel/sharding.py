@@ -1,0 +1,140 @@
+"""Multi-chip sharding of the proving pipeline over a jax device mesh.
+
+The reference is single-node rayon data parallelism (SURVEY.md §2.4;
+`/root/reference/src/worker/mod.rs:5`). The TPU-native scaling axes are:
+
+- ``col``  — trace columns. Through round 3 every polynomial op (iNTT, coset
+  LDE, gate sweep) is per-column, so columns shard across chips with ZERO
+  communication; this is the tensor-parallel analogue.
+- ``row``  — the LDE domain. Merkle leaf hashing consumes ALL columns of one
+  domain row, so between the per-column NTT phase and the hashing phase the
+  layout pivots from column-sharded to row-sharded — one all-to-all that XLA
+  inserts from sharding constraints (the framework never writes a collective
+  by hand; GSPMD propagates them over ICI).
+
+Merkle caps, transcript inputs and FRI final polys are tiny and replicated.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..field import gl
+from ..field import goldilocks as gf
+from ..field import extension as ext_f
+from ..hashes.poseidon2 import leaf_hash, node_hash
+from ..ntt import lde_from_monomial, monomial_from_values, powers_device
+
+
+def make_mesh(devices=None, col_axis: int | None = None) -> Mesh:
+    """2D ('col', 'row') mesh over the given (or all) devices.
+
+    col_axis devices shard trace columns; the rest shard LDE-domain rows.
+    """
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if col_axis is None:
+        # favor the column axis: columns carry the zero-communication phase
+        col_axis = 1 << ((n.bit_length() - 1 + 1) // 2)
+        while n % col_axis:
+            col_axis //= 2
+    row_axis = n // col_axis
+    dev_grid = np.array(devices).reshape(col_axis, row_axis)
+    return Mesh(dev_grid, axis_names=("col", "row"))
+
+
+def col_sharding(mesh: Mesh) -> NamedSharding:
+    """(C, n) polynomial storage: columns across 'col', rows replicated."""
+    return NamedSharding(mesh, P("col", None))
+
+
+def leaf_sharding(mesh: Mesh) -> NamedSharding:
+    """(num_leaves, width) leaf storage: leaves across BOTH mesh axes."""
+    return NamedSharding(mesh, P(("col", "row"), None))
+
+
+def _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma):
+    """Copy-permutation grand-product numerator/denominator accumulation and
+    the z poly, all-column form (see stages.compute_copy_permutation_stage2;
+    this fragment keeps the per-column products column-sharded and lets the
+    scan run on the replicated row axis)."""
+    C, n = copy_vals.shape
+    omega = gl.omega(n.bit_length() - 1)
+    xs = powers_device(omega, n)
+    b0, b1 = beta[0], beta[1]
+    g0, g1 = gamma[0], gamma[1]
+    ks = non_residues
+    kx = gf.mul(xs[None, :], ks[:, None])  # (C, n)
+    num = (
+        gf.add(gf.add(copy_vals, gf.mul(kx, b0)), g0),
+        gf.add(gf.mul(kx, b1), g1),
+    )
+    den = (
+        gf.add(gf.add(copy_vals, gf.mul(sigma_vals, b0)), g0),
+        gf.add(gf.mul(sigma_vals, b1), g1),
+    )
+    # product across the column axis (log-depth tree of ext muls; XLA turns
+    # the column-sharded operand into a psum-style tree over ICI)
+    def tree_prod(pair):
+        c0, c1 = pair
+        while c0.shape[0] > 1:
+            if c0.shape[0] % 2:
+                c0 = jnp.concatenate([c0, jnp.ones((1, c0.shape[1]), jnp.uint64)])
+                c1 = jnp.concatenate([c1, jnp.zeros((1, c1.shape[1]), jnp.uint64)])
+            h = c0.shape[0] // 2
+            c0, c1 = ext_f.mul((c0[:h], c1[:h]), (c0[h:], c1[h:]))
+        return c0[0], c1[0]
+
+    num_p = tree_prod(num)
+    den_p = tree_prod(den)
+    ratio = ext_f.mul(num_p, ext_f.batch_inverse(den_p))
+    incl = jax.lax.associative_scan(ext_f.mul, ratio, axis=-1)
+    one = jnp.ones((1,), jnp.uint64)
+    zero = jnp.zeros((1,), jnp.uint64)
+    return (
+        jnp.concatenate([one, incl[0][:-1]]),
+        jnp.concatenate([zero, incl[1][:-1]]),
+    )
+
+
+def _prove_fragment(copy_vals, sigma_vals, non_residues, beta, gamma,
+                    lde_factor, cap_size, mesh):
+    """Rounds 1+2 core: per-column iNTT -> coset LDE -> Merkle digest layers
+    (with the col->row layout pivot) and the copy-permutation z poly."""
+    C, n = copy_vals.shape
+    mono = monomial_from_values(copy_vals)  # column-sharded, no comm
+    lde = lde_from_monomial(mono, lde_factor)  # (C, L, n) still per-column
+    leaves = lde.reshape(C, -1).T  # (L*n, C): the layout pivot
+    leaves = jax.lax.with_sharding_constraint(leaves, leaf_sharding(mesh))
+    digests = leaf_hash(leaves)  # (L*n, 4) row-sharded
+    while digests.shape[0] > cap_size:
+        digests = node_hash(digests[0::2], digests[1::2])
+    cap = jax.lax.with_sharding_constraint(
+        digests, NamedSharding(mesh, P(None, None))
+    )
+    z = _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma)
+    return cap, z
+
+
+def sharded_prove_fragment(mesh: Mesh, lde_factor: int = 4, cap_size: int = 4):
+    """Jit the prove fragment with column-sharded inputs over `mesh`.
+
+    Inputs: copy_vals/sigma_vals (C, n) uint64; non_residues (C,) uint64;
+    beta/gamma (2,) uint64 extension scalars.
+    """
+    cs = col_sharding(mesh)
+    rep = NamedSharding(mesh, P())
+
+    def run(copy_vals, sigma_vals, non_residues, beta, gamma):
+        return _prove_fragment(
+            copy_vals, sigma_vals, non_residues, beta, gamma,
+            lde_factor, cap_size, mesh,
+        )
+
+    return jax.jit(run, in_shardings=(cs, cs, rep, rep, rep))

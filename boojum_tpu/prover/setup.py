@@ -182,6 +182,9 @@ def generate_setup(assembly, config) -> SetupData:
             "do not use enforce_lookup/perform_lookup"
         )
     n = assembly.trace_len
+    assert config.fri_final_degree < n, (
+        "fri_final_degree must be below the trace length (at least one fold)"
+    )
     selector_paths = build_selector_paths(assembly.gates)
     # masked-constraint degree must fit the quotient LDE domain:
     # (selector depth + gate degree) * (n-1) <= L*n - 1, conservatively

@@ -94,6 +94,9 @@ def verify(vk, proof, gates) -> bool:
     while deg > final_degree:
         deg //= 2
         num_folds += 1
+    if num_folds < 1:
+        # fri_prove refuses zero-fold schedules; mirror that as a rejection
+        return False
     if len(proof.fri_caps) != num_folds:
         return False
     fri_challenges = []

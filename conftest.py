@@ -28,6 +28,13 @@ try:
 
     jax.config.update("jax_platforms", "cpu")
     xla_bridge._backend_factories.pop("axon", None)
+    # XLA:CPU compiles of the big unrolled prover graphs take minutes; cache
+    # them persistently so only the first-ever run pays.
+    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".jax_cache")
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 except Exception:
     pass
 
