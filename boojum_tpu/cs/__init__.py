@@ -10,3 +10,11 @@ from .types import (
     is_wit,
     place_index,
 )
+from .lookup_table import (
+    LookupTable,
+    and8_table,
+    xor8_table,
+    or8_table,
+    binop_table,
+    range_check_table,
+)

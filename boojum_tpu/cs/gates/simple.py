@@ -43,6 +43,13 @@ class FmaGate(Gate):
         cs.place_gate(FmaGate.instance(), [a, b, c, d], (ca, cc))
         return d
 
+    @staticmethod
+    def enforce_fma(cs, a, b, c, d, coeff_ab=1, coeff_c=1):
+        """Constrain coeff_ab·a·b + coeff_c·c = d over EXISTING variables
+        (the reference's gate-with-rhs_part form, fma_gate_without_constant.rs)."""
+        ca, cc = coeff_ab % gl.P, coeff_c % gl.P
+        cs.place_gate(FmaGate.instance(), [a, b, c, d], (ca, cc))
+
     _inst = None
 
     @classmethod
@@ -203,6 +210,12 @@ class ReductionGate(Gate):
         cs.set_values_with_dependencies(list(vars4), [out], resolve)
         cs.place_gate(ReductionGate.instance(), list(vars4) + [out], tuple(cf))
         return out
+
+    @staticmethod
+    def enforce_reduce(cs, vars4, coeffs4, out):
+        """Constrain sum coeff_i·x_i = out over EXISTING variables."""
+        cf = [c % gl.P for c in coeffs4]
+        cs.place_gate(ReductionGate.instance(), list(vars4) + [out], tuple(cf))
 
     _inst = None
 

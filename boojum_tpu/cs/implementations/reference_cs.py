@@ -182,16 +182,22 @@ class ConstraintSystem:
 
         Placement into specialized lookup columns happens at freeze; here we
         record the tuple and bump multiplicity eagerly via the resolver.
+        Tuples narrower than the argument width are padded with zero
+        variables (tables are zero-column-padded to match at setup).
         """
         params = self.lookup_params
         assert params.is_enabled, "lookups not configured"
-        assert len(keys) == params.width
-        self.lookup_rows.append((table_id, list(keys)))
+        table = self.get_table(table_id)
+        assert len(keys) == table.width
+        assert table.width <= params.width
+        keys = list(keys)
+        while len(keys) < params.width:
+            keys.append(self.zero_var())
+        self.lookup_rows.append((table_id, keys))
         if self.config.evaluate_witness:
-            table = self.get_table(table_id)
 
             def bump(vals, table=table, table_id=table_id):
-                row_idx = table.row_index(tuple(vals))
+                row_idx = table.row_index(tuple(vals[: table.width]))
                 key = (table_id, row_idx)
                 self.lookup_multiplicities[key] = (
                     self.lookup_multiplicities.get(key, 0) + 1
@@ -244,17 +250,107 @@ class ConstraintSystem:
                         self.copy_placement[off + i, row] = p
                     used += 1
                 tool[1] = used
+        # rows needed by the specialized lookup columns (R tuples per row,
+        # grouped by table id since the id is a shared per-row constant)
+        lookup_rows_needed = 0
+        if self.lookup_rows:
+            R = self.lookup_params.num_repetitions
+            per_table: dict[int, int] = {}
+            for tid, _ in self.lookup_rows:
+                per_table[tid] = per_table.get(tid, 0) + 1
+            lookup_rows_needed = sum(
+                (cnt + R - 1) // R for cnt in per_table.values()
+            )
+        # total stacked table content must also fit the trace
+        table_content_rows = sum(len(t) for t in self.lookup_tables)
         # round up to a power of two; vacant rows become NOP rows
-        n = 1 << max(3, (max(self.next_row, 1) - 1).bit_length())
+        rows = max(self.next_row, lookup_rows_needed, table_content_rows, 1)
+        n = 1 << max(3, (rows - 1).bit_length())
         assert n <= self.max_trace_len
         nop_gid = self._register_gate(NopGate.instance())
         self.row_gate[: n][self.row_gate[:n] < 0] = nop_gid
         self.trace_len = n
         return n
 
+    def _place_lookups(self, n: int):
+        """Pack recorded lookup tuples into the specialized columns.
+
+        Returns (lookup_placement (R*w, n) int64, table_id_col (n,) uint64).
+        Every row performs R lookups: vacant slots (and entirely vacant rows)
+        are filled with a shared "padding tuple" per table — fresh variables
+        resolving to the table's row 0 — whose multiplicity bumps are added
+        here so the log-derivative sum stays balanced (the reference pads the
+        same way: lookup_placement.rs:112).
+        """
+        params = self.lookup_params
+        R = params.num_repetitions
+        w = params.width
+        placement = np.full((R * w, n), -1, dtype=np.int64)
+        table_id_col = np.zeros(n, dtype=np.uint64)
+        evaluating = self.config.evaluate_witness
+
+        pad_tuples: dict[int, list[int]] = {}
+
+        def padding_tuple(tid: int) -> list[int]:
+            tup = pad_tuples.get(tid)
+            if tup is None:
+                table = self.get_table(tid)
+                row0 = [int(v) for v in table.content[0]] + [0] * (
+                    w - table.width
+                )
+                tup = self.alloc_multiple_variables_without_values(w)
+                for p, v in zip(tup, row0):
+                    self.resolver.set_value(p, v)
+                pad_tuples[tid] = tup
+            return tup
+
+        def bump_padding(tid: int, times: int):
+            if evaluating and times:
+                key = (tid, 0)
+                self.lookup_multiplicities[key] = (
+                    self.lookup_multiplicities.get(key, 0) + times
+                )
+
+        by_table: dict[int, list[list[int]]] = {}
+        for tid, places in self.lookup_rows:
+            by_table.setdefault(tid, []).append(places)
+
+        row = 0
+        for tid in sorted(by_table):
+            tuples = by_table[tid]
+            for i in range(0, len(tuples), R):
+                chunk = tuples[i : i + R]
+                pad_count = R - len(chunk)
+                if pad_count:
+                    chunk = chunk + [padding_tuple(tid)] * pad_count
+                    bump_padding(tid, pad_count)
+                table_id_col[row] = tid
+                for s, places in enumerate(chunk):
+                    placement[s * w : (s + 1) * w, row] = places
+                row += 1
+        # entirely vacant rows: padding lookups into the first table
+        if row < n and self.lookup_tables:
+            tid = 1
+            tup = padding_tuple(tid)
+            table_id_col[row:] = tid
+            for s in range(R):
+                placement[s * w : (s + 1) * w, row:] = np.array(
+                    tup, dtype=np.int64
+                )[:, None]
+            bump_padding(tid, (n - row) * R)
+        return placement, table_id_col
+
     def into_assembly(self) -> "CSAssembly":
         self.resolver.wait_till_resolved()
         n = getattr(self, "trace_len", None) or self.pad_and_shrink()
+        lookups_on = bool(self.lookup_rows) or (
+            self.lookup_params.is_enabled and bool(self.lookup_tables)
+        )
+        if lookups_on:
+            lookup_placement, table_id_col = self._place_lookups(n)
+        else:
+            lookup_placement = np.zeros((0, n), dtype=np.int64)
+            table_id_col = None
         num_places = 2 * max(self.next_var_idx, self.next_wit_idx) + 2
         arena = self.resolver.values
         if len(arena) < num_places:
@@ -271,6 +367,20 @@ class ConstraintSystem:
 
         copy_cols = scatter(self.copy_placement)
         wit_cols = scatter(self.wit_placement)
+        lookup_cols = scatter(lookup_placement)
+        # multiplicity column over the stacked-table row space
+        multiplicities = None
+        table_offsets = {}
+        if lookups_on:
+            off = 0
+            for tid in range(1, len(self.lookup_tables) + 1):
+                table_offsets[tid] = off
+                off += len(self.get_table(tid))
+            assert off <= n, "stacked lookup tables exceed trace length"
+            multiplicities = np.zeros(n, dtype=np.uint64)
+            if self.config.evaluate_witness:
+                for (tid, row_idx), cnt in self.lookup_multiplicities.items():
+                    multiplicities[table_offsets[tid] + row_idx] = cnt
         return CSAssembly(
             geometry=self.geometry,
             lookup_params=self.lookup_params,
@@ -291,6 +401,11 @@ class ConstraintSystem:
             lookup_tables=self.lookup_tables,
             lookup_rows=self.lookup_rows,
             lookup_multiplicities=self.lookup_multiplicities,
+            lookup_placement=lookup_placement,
+            lookup_cols_values=lookup_cols,
+            lookup_table_id_col=table_id_col,
+            multiplicities=multiplicities,
+            table_offsets=table_offsets,
             resolver=self.resolver,
         )
 
@@ -303,8 +418,38 @@ class CSAssembly:
 
     @property
     def num_copy_cols(self):
+        """General-purpose copy columns (gates live here)."""
         return self.geometry.num_columns_under_copy_permutation
+
+    @property
+    def num_lookup_cols(self):
+        """Specialized lookup copy columns, appended after the general ones."""
+        return self.lookup_placement.shape[0]
+
+    @property
+    def num_copy_cols_total(self):
+        """All columns under copy permutation (general + lookup)."""
+        return self.num_copy_cols + self.num_lookup_cols
 
     @property
     def num_wit_cols(self):
         return self.geometry.num_witness_columns
+
+    @property
+    def lookups_enabled(self):
+        return self.num_lookup_cols > 0
+
+    def stacked_table_columns(self, width: int) -> np.ndarray:
+        """(width+1, n) setup polys: table columns zero-padded to `width`,
+        plus the table-id column, stacked over all tables in id order
+        (reference create_lookup_tables_columns_polys, setup.rs:892)."""
+        n = self.trace_len
+        cols = np.zeros((width + 1, n), dtype=np.uint64)
+        off = 0
+        for tid in range(1, len(self.lookup_tables) + 1):
+            t = self.lookup_tables[tid - 1]
+            rows = len(t)
+            cols[: t.width, off : off + rows] = t.content.T
+            cols[width, off : off + rows] = tid
+            off += rows
+        return cols

@@ -1,0 +1,90 @@
+"""Runtime lookup tables.
+
+Counterpart of `/root/reference/src/cs/implementations/lookup_table.rs:10`
+(`LookupTable<F, N>` content + key->row index map) without the width-generic
+wrapper enums: a table is a dense (rows, width) numpy array plus a dict from
+key tuple to row index. Table ids are allocated by the CS starting at 1
+(`reference_cs.rs:23`), so id 0 never collides with the zero padding of the
+table-id setup polynomial.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..field import gl
+
+
+class LookupTable:
+    def __init__(self, name: str, num_keys: int, num_values: int, rows):
+        """rows: iterable of tuples of ints, each of width num_keys+num_values."""
+        self.name = name
+        self.num_keys = num_keys
+        self.num_values = num_values
+        self.width = num_keys + num_values
+        content = np.array(
+            [[int(v) % gl.P for v in row] for row in rows], dtype=np.uint64
+        )
+        assert content.ndim == 2 and content.shape[1] == self.width, (
+            f"table {name}: rows must have width {self.width}"
+        )
+        self.content = content
+        self._index = {
+            tuple(int(v) for v in row[: num_keys]): i
+            for i, row in enumerate(content)
+        }
+        assert len(self._index) == len(content), f"table {name}: duplicate keys"
+
+    def __len__(self):
+        return len(self.content)
+
+    def row_index(self, vals) -> int:
+        """Row index of a full (keys+values) tuple; keys alone also accepted."""
+        key = tuple(int(v) for v in vals[: self.num_keys])
+        idx = self._index[key]
+        if len(vals) > self.num_keys:
+            expect = tuple(int(v) for v in self.content[idx])
+            assert tuple(int(v) for v in vals) == expect, (
+                f"table {self.name}: tuple {vals} is not a table row"
+            )
+        return idx
+
+    def lookup_values(self, keys) -> tuple:
+        row = self.content[self._index[tuple(int(k) for k in keys)]]
+        return tuple(int(v) for v in row[self.num_keys :])
+
+
+# ---------------------------------------------------------------------------
+# Common table builders (reference `src/gadgets/tables/`)
+# ---------------------------------------------------------------------------
+
+
+def binop_table(name: str, op) -> LookupTable:
+    """8-bit binary op table: (a, b) -> op(a, b); 65536 rows."""
+    a = np.arange(256, dtype=np.uint64).repeat(256)
+    b = np.tile(np.arange(256, dtype=np.uint64), 256)
+    return LookupTable(name, 2, 1, np.stack([a, b, op(a, b)], axis=1))
+
+
+def and8_table() -> LookupTable:
+    return binop_table("and8", lambda a, b: a & b)
+
+
+def xor8_table() -> LookupTable:
+    return binop_table("xor8", lambda a, b: a ^ b)
+
+
+def or8_table() -> LookupTable:
+    return binop_table("or8", lambda a, b: a | b)
+
+
+def range_check_table(bits: int, name: str | None = None) -> LookupTable:
+    """[0, 2^bits) membership table, one key column, zero value columns...
+    represented as (x, 0) pairs (width-2) so the table is usable in width-2
+    sub-arguments alongside other tables (reference range_check_16_bits.rs
+    uses a 1-column table; we carry an explicit zero value column to keep all
+    tables in one stacked layout)."""
+    n = 1 << bits
+    x = np.arange(n, dtype=np.uint64)
+    z = np.zeros(n, dtype=np.uint64)
+    return LookupTable(name or f"range_{bits}", 1, 1, np.stack([x, z], axis=1))

@@ -1,0 +1,10 @@
+from .tables import (
+    trixor4_table,
+    ch4_table,
+    maj4_table,
+    split4bit_table,
+)
+from .sha256 import sha256, sha256_digest_bytes, allocate_u8_input
+from .boolean import Boolean
+from .num import Num
+from .uint import UInt8, UInt16, UInt32

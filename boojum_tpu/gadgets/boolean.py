@@ -1,0 +1,78 @@
+"""Boolean gadget (reference `/root/reference/src/gadgets/boolean/`, 715 LoC).
+
+A Boolean wraps a variable constrained to {0,1} via the x²=x gate. Logic ops
+are single FMA gates over the arithmetic encodings:
+  and: a·b          or: a+b−a·b        xor: a+b−2ab        not: 1−a
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import BooleanConstraintGate, FmaGate, SelectionGate
+from ..field import gl
+
+
+class Boolean:
+    __slots__ = ("var",)
+
+    def __init__(self, var: int):
+        self.var = var
+
+    # -- allocation ---------------------------------------------------------
+
+    @staticmethod
+    def allocate(cs, value: bool) -> "Boolean":
+        v = cs.alloc_variable_with_value(1 if value else 0)
+        BooleanConstraintGate.enforce(cs, v)
+        return Boolean(v)
+
+    @staticmethod
+    def allocated_constant(cs, value: bool) -> "Boolean":
+        return Boolean(cs.one_var() if value else cs.zero_var())
+
+    @staticmethod
+    def from_variable_checked(cs, var: int) -> "Boolean":
+        BooleanConstraintGate.enforce(cs, var)
+        return Boolean(var)
+
+    def get_value(self, cs) -> bool:
+        return cs.get_value(self.var) == 1
+
+    # -- logic --------------------------------------------------------------
+
+    def and_(self, cs, other: "Boolean") -> "Boolean":
+        return Boolean(FmaGate.fma(cs, self.var, other.var, cs.zero_var(), 1, 0))
+
+    def or_(self, cs, other: "Boolean") -> "Boolean":
+        # a + b - ab  =  -(a·b) + 1·(a+b); build via t = a·b, out = a+b-t
+        t = FmaGate.fma(cs, self.var, other.var, cs.zero_var(), 1, 0)
+        s = FmaGate.fma(cs, cs.one_var(), self.var, other.var, 1, 1)
+        return Boolean(FmaGate.fma(cs, cs.one_var(), t, s, gl.P - 1, 1))
+
+    def xor(self, cs, other: "Boolean") -> "Boolean":
+        # a + b - 2ab
+        s = FmaGate.fma(cs, cs.one_var(), self.var, other.var, 1, 1)
+        return Boolean(FmaGate.fma(cs, self.var, other.var, s, gl.P - 2, 1))
+
+    def negate(self, cs) -> "Boolean":
+        # 1 - a  =  (P-1)·one·a + 1·one
+        return Boolean(
+            FmaGate.fma(cs, cs.one_var(), self.var, cs.one_var(), gl.P - 1, 1)
+        )
+
+    @staticmethod
+    def select(cs, flag: "Boolean", a: "Boolean", b: "Boolean") -> "Boolean":
+        return Boolean(SelectionGate.select(cs, flag.var, a.var, b.var))
+
+    @staticmethod
+    def multi_and(cs, bools) -> "Boolean":
+        acc = bools[0]
+        for b in bools[1:]:
+            acc = acc.and_(cs, b)
+        return acc
+
+    @staticmethod
+    def multi_or(cs, bools) -> "Boolean":
+        acc = bools[0]
+        for b in bools[1:]:
+            acc = acc.or_(cs, b)
+        return acc

@@ -1,0 +1,75 @@
+"""Shared 4-bit-chunk plumbing used by the UIntX and SHA-256 gadgets.
+
+The membership check rides the TriXor4 table (a chunk appearing in any key
+column of a TriXor4 lookup is forced into [0,16)); recomposition is a chained
+ReductionGate scan. Counterpart of the reference's per-gadget repetitions of
+the same idiom (round_function.rs:153, :678; u32 decompositions).
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import ReductionGate
+from .tables import trixor4_table
+
+MASK4 = 0xF
+
+
+def ensure_trixor(cs) -> int:
+    t = trixor4_table()
+    if t.name not in cs._table_by_name:
+        cs.add_lookup_table(t)
+    return cs.get_table_id(t.name)
+
+
+def range_check_chunks_batched(cs, chunks, table_id=None):
+    """4-bit membership checks through TriXor4, three chunks per lookup."""
+    if not chunks:
+        return
+    if table_id is None:
+        table_id = ensure_trixor(cs)
+    zero = cs.zero_var()
+    for i in range(0, len(chunks), 3):
+        batch = list(chunks[i : i + 3])
+        while len(batch) < 3:
+            batch.append(zero)
+        cs.perform_lookup(table_id, batch)
+
+
+def enforce_chunk_recomposition(cs, chunks, var, bits_per_chunk=4):
+    """Enforce var == Σ chunk_i · 2^(bits·i) via a ReductionGate chain."""
+    acc = None
+    shift = 0
+    rem = list(chunks)
+    while rem:
+        part, rem = rem[:3], rem[3:]
+        vars4, cf = [], []
+        if acc is not None:
+            vars4.append(acc)
+            cf.append(1)
+        for c in part:
+            vars4.append(c)
+            cf.append(1 << shift)
+            shift += bits_per_chunk
+        while len(vars4) < 4:
+            vars4.append(cs.zero_var())
+            cf.append(0)
+        if rem:
+            acc = ReductionGate.reduce(cs, vars4, cf)
+        else:
+            ReductionGate.enforce_reduce(cs, vars4, cf, var)
+
+
+def decompose_and_check(cs, var, num_bits):
+    """Split var into range-checked 4-bit chunks + enforce recomposition."""
+    assert num_bits % 4 == 0
+    k = num_bits // 4
+    chunks = cs.alloc_multiple_variables_without_values(k)
+
+    def resolve(vals):
+        x = vals[0]
+        return [(x >> (4 * i)) & MASK4 for i in range(k)]
+
+    cs.set_values_with_dependencies([var], chunks, resolve)
+    enforce_chunk_recomposition(cs, chunks, var)
+    range_check_chunks_batched(cs, chunks)
+    return chunks

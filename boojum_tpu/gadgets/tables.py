@@ -1,0 +1,52 @@
+"""Gadget lookup-table builders.
+
+Counterparts of `/root/reference/src/gadgets/tables/`: trixor4.rs, ch4.rs,
+maj4.rs, chunk4bits.rs (and the 8-bit binops / range checks, which live in
+`boojum_tpu.cs.lookup_table`). All SHA-256 tables are width ≤ 4 so they fit
+the reference bench's width-4 specialized lookup sub-arguments.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..cs.lookup_table import LookupTable
+
+
+def _tri_table(name: str, fn) -> LookupTable:
+    """All (a, b, c) in [0,16)^3 -> fn(a,b,c) & 0xF; 4096 rows."""
+    a = np.arange(16, dtype=np.uint64).repeat(256)
+    b = np.tile(np.arange(16, dtype=np.uint64).repeat(16), 16)
+    c = np.tile(np.arange(16, dtype=np.uint64), 256)
+    v = fn(a, b, c) & np.uint64(0xF)
+    return LookupTable(name, 3, 1, np.stack([a, b, c, v], axis=1))
+
+
+def trixor4_table() -> LookupTable:
+    """a ^ b ^ c on 4-bit chunks (reference trixor4.rs). Doubles as the
+    4-bit range check (lookup membership forces chunks into [0,16))."""
+    return _tri_table("trixor4", lambda a, b, c: a ^ b ^ c)
+
+
+def ch4_table() -> LookupTable:
+    """SHA-256 choice: (a & b) ^ (~a & c) on 4-bit chunks (reference ch4.rs)."""
+    return _tri_table("ch4", lambda a, b, c: (a & b) ^ (~a & c))
+
+
+def maj4_table() -> LookupTable:
+    """SHA-256 majority: (a&b) ^ (a&c) ^ (b&c) (reference maj4.rs)."""
+    return _tri_table("maj4", lambda a, b, c: (a & b) ^ (a & c) ^ (b & c))
+
+
+def split4bit_table(split_at: int) -> LookupTable:
+    """x in [0,16) -> (low = x & mask, high = x >> split_at, reversed =
+    low·2^(4-split_at) | high) (reference chunk4bits.rs
+    create_4bit_chunk_split_table)."""
+    assert split_at in (1, 2)
+    x = np.arange(16, dtype=np.uint64)
+    low = x & np.uint64((1 << split_at) - 1)
+    high = x >> np.uint64(split_at)
+    rev = (low << np.uint64(4 - split_at)) | high
+    return LookupTable(
+        f"split4bit_at{split_at}", 1, 3, np.stack([x, low, high, rev], axis=1)
+    )

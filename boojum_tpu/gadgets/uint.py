@@ -1,0 +1,115 @@
+"""Fixed-width unsigned integer gadgets: UInt8 / UInt16 / UInt32 (reference
+`/root/reference/src/gadgets/u8,u16,u32/`, 3,249 LoC across widths).
+
+Range correctness comes from 4-bit-chunk lookups against the TriXor4 table
+(the same strategy the SHA-256 circuit uses — membership in [0,16) per
+chunk); carry arithmetic uses the UIntXAdd / U32 gates.
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import ReductionGate, SelectionGate
+from ..cs.gates.u32 import U32AddGate, U32FmaGate, U32SubGate, UIntXAddGate
+from .boolean import Boolean
+from .num import Num
+from .chunk_utils import decompose_and_check as _decompose_and_check
+
+
+class UIntX:
+    """Common machinery; subclasses pin WIDTH."""
+
+    WIDTH = 0
+    __slots__ = ("var",)
+
+    def __init__(self, var: int):
+        self.var = var
+
+    @classmethod
+    def allocate_checked(cls, cs, value: int) -> "UIntX":
+        assert 0 <= value < (1 << cls.WIDTH)
+        v = cs.alloc_variable_with_value(value)
+        _decompose_and_check(cs, v, cls.WIDTH)
+        return cls(v)
+
+    @classmethod
+    def allocated_constant(cls, cs, value: int) -> "UIntX":
+        assert 0 <= value < (1 << cls.WIDTH)
+        return cls(cs.allocate_constant(value))
+
+    @classmethod
+    def from_variable_checked(cls, cs, var: int) -> "UIntX":
+        _decompose_and_check(cs, var, cls.WIDTH)
+        return cls(var)
+
+    def get_value(self, cs) -> int:
+        return cs.get_value(self.var)
+
+    def into_num(self) -> Num:
+        return Num(self.var)
+
+    # -- arithmetic (checked) ----------------------------------------------
+
+    def add(self, cs, other):
+        """(sum, carry_out boolean)."""
+        gate = UIntXAddGate(self.WIDTH) if self.WIDTH != 32 else None
+        if gate is None:
+            c, cout = U32AddGate.add(cs, self.var, other.var, cs.zero_var())
+        else:
+            c, cout = gate.add(cs, self.var, other.var, cs.zero_var())
+        _decompose_and_check(cs, c, self.WIDTH)
+        return type(self)(c), Boolean(cout)
+
+    def sub(self, cs, other):
+        """(difference, borrow_out boolean)."""
+        assert self.WIDTH == 32, "sub gate is 32-bit"
+        c, bout = U32SubGate.sub(cs, self.var, other.var, cs.zero_var())
+        _decompose_and_check(cs, c, self.WIDTH)
+        return type(self)(c), Boolean(bout)
+
+    @staticmethod
+    def select(cs, flag: Boolean, a, b):
+        assert type(a) is type(b)
+        return type(a)(SelectionGate.select(cs, flag.var, a.var, b.var))
+
+
+class UInt8(UIntX):
+    WIDTH = 8
+
+
+class UInt16(UIntX):
+    WIDTH = 16
+
+
+class UInt32(UIntX):
+    WIDTH = 32
+
+    @staticmethod
+    def from_be_bytes(cs, bytes4) -> "UInt32":
+        """4 UInt8 -> u32 (reference u32/mod.rs from_be_bytes)."""
+        v = ReductionGate.reduce(
+            cs, [b.var for b in bytes4], [1 << 24, 1 << 16, 1 << 8, 1]
+        )
+        return UInt32(v)
+
+    def to_le_bytes(self, cs) -> list:
+        """Decompose into 4 checked UInt8 (LE)."""
+        outs = cs.alloc_multiple_variables_without_values(4)
+
+        def resolve(vals):
+            x = vals[0]
+            return [(x >> (8 * i)) & 0xFF for i in range(4)]
+
+        cs.set_values_with_dependencies([self.var], outs, resolve)
+        ReductionGate.enforce_reduce(
+            cs, list(outs), [1, 1 << 8, 1 << 16, 1 << 24], self.var
+        )
+        return [UInt8.from_variable_checked(cs, o) for o in outs]
+
+    def fma(self, cs, other: "UInt32", addend: "UInt32"):
+        """(low, high) of self·other + addend (reference u32_fma.rs)."""
+        low, high = U32FmaGate.fma(
+            cs, self.var, other.var, addend.var, cs.zero_var()
+        )
+        _decompose_and_check(cs, low, 32)
+        _decompose_and_check(cs, high, 32)
+        return UInt32(low), UInt32(high)

@@ -2,11 +2,19 @@
 
 Round structure (transcript order is the protocol; the verifier replays it):
   0. absorb setup cap + public inputs
-  1. commit witness columns (monomial -> coset LDE -> Merkle) ... draw beta, gamma
-  2. commit stage-2 (copy-permutation z + partial products)   ... draw alpha
+  1. commit witness columns (monomial -> coset LDE -> Merkle) ... draw beta,
+     gamma (+ lookup beta', gamma' when lookups are on)
+  2. commit stage-2 (copy-permutation z + partial products, lookup A_i/B)
+     ... draw alpha
   3. commit quotient chunks                                   ... draw z
-  4. absorb evaluations at z (and z*omega for the grand product) ... draw DEEP
+  4. absorb evaluations at z (z*omega for the grand product; 0 for the
+     lookup sum polys)                                        ... draw DEEP
   5. DEEP quotening -> FRI fold rounds -> queries
+
+Witness oracle column order: [general copy | lookup copy | witness |
+multiplicities]; setup oracle: [sigma (all copy cols) | constants (+table-id)
+| stacked table columns]; stage-2 oracle: [z | partials | A_i | B], every ext
+poly as its (c0, c1) base column pair.
 
 Every polynomial op in rounds 1-3 and 5 is a whole-array device computation;
 the host only sequences rounds, runs the transcript, and gathers query paths.
@@ -40,9 +48,11 @@ from .proof import OracleQuery, Proof, SingleRoundQueries
 from .stages import (
     alpha_powers_iter,
     compute_copy_permutation_stage2,
+    compute_lookup_polys,
     copy_permutation_quotient_terms,
     ext_scalar,
     gate_terms_contribution,
+    lookup_quotient_terms,
 )
 
 
@@ -77,8 +87,6 @@ def _vanishing_inv_brev(log_n, lde_factor):
 
 
 def prove(assembly, setup, config: ProofConfig) -> Proof:
-    if assembly.lookup_params.is_enabled or assembly.lookup_rows:
-        raise NotImplementedError("lookup argument not wired into prover yet")
     n = assembly.trace_len
     log_n = n.bit_length() - 1
     L = config.fri_lde_factor
@@ -86,9 +94,15 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     N = n * L
     cap = config.merkle_tree_cap_size
     geometry = assembly.geometry
-    C = assembly.copy_placement.shape[0]
+    Cg = assembly.copy_placement.shape[0]
+    LC = assembly.num_lookup_cols
+    Ct = Cg + LC
     W = assembly.wit_placement.shape[0]
-    K = geometry.num_constant_columns
+    lookups = assembly.lookups_enabled
+    M = 1 if lookups else 0
+    K = geometry.num_constant_columns + (1 if lookups else 0)
+    lp = assembly.lookup_params
+    TW = (lp.width + 1) if lookups else 0  # table setup columns
 
     t = Poseidon2Transcript()
     t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
@@ -98,25 +112,46 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     # ---- round 1: witness commitment -------------------------------------
     copy_vals = jnp.asarray(assembly.copy_cols_values)
     cols = [copy_vals]
+    if LC:
+        copy_vals = jnp.concatenate(
+            [copy_vals, jnp.asarray(assembly.lookup_cols_values)], axis=0
+        )
+        cols = [copy_vals]
     if W:
         cols.append(jnp.asarray(assembly.wit_cols_values))
+    if M:
+        cols.append(jnp.asarray(assembly.multiplicities)[None, :])
     witness_cols = jnp.concatenate(cols, axis=0) if len(cols) > 1 else cols[0]
     wit_mono = monomial_from_values(witness_cols)
-    wit_lde = lde_from_monomial(wit_mono, L)  # (C+W, L, n)
+    wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
     wit_tree, _ = _commit_columns(wit_lde, cap)
     t.witness_merkle_tree_cap(wit_tree.get_cap())
     beta = t.get_ext_challenge()
     gamma = t.get_ext_challenge()
+    if lookups:
+        lookup_beta = t.get_ext_challenge()
+        lookup_gamma = t.get_ext_challenge()
 
-    # ---- round 2: copy-permutation stage 2 -------------------------------
+    # ---- round 2: copy-permutation + lookup stage 2 ----------------------
     sigma_dev = jnp.asarray(setup.sigma_cols)
     z, partials, chunks = compute_copy_permutation_stage2(
         copy_vals, sigma_dev, setup.non_residues, beta, gamma,
         geometry.max_allowed_constraint_degree,
     )
-    stage2_cols = jnp.stack(
-        [z[0], z[1]] + [c for p in partials for c in (p[0], p[1])]
-    )
+    stage2_list = [z[0], z[1]] + [c for p in partials for c in (p[0], p[1])]
+    num_partials = len(partials)
+    if lookups:
+        table_cols_dev = jnp.asarray(setup.constant_cols[-1])  # table-id col
+        a_polys, b_poly = compute_lookup_polys(
+            copy_vals[Cg:], table_cols_dev,
+            jnp.asarray(assembly.stacked_table_columns(lp.width)),
+            jnp.asarray(assembly.multiplicities),
+            lookup_beta, lookup_gamma, lp.num_repetitions, lp.width,
+        )
+        for a in a_polys:
+            stage2_list += [a[0], a[1]]
+        stage2_list += [b_poly[0], b_poly[1]]
+    stage2_cols = jnp.stack(stage2_list)
     s2_mono = monomial_from_values(stage2_cols)
     s2_lde = lde_from_monomial(s2_mono, L)
     s2_tree, _ = _commit_columns(s2_lde, cap)
@@ -124,11 +159,13 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     alpha = t.get_ext_challenge()
 
     # ---- round 3: quotient -----------------------------------------------
-    copy_lde_flat = wit_lde[:C].reshape(C, N)
-    wit_lde_flat = wit_lde[C:].reshape(W, N) if W else None
-    setup_lde_flat = setup.setup_lde.reshape(C + K, N)
-    sigma_lde_flat = setup_lde_flat[:C]
-    const_lde_flat = setup_lde_flat[C:]
+    wit_lde_all = wit_lde.reshape(Ct + W + M, N)
+    copy_lde_flat = wit_lde_all[:Ct]
+    gate_wit_lde = wit_lde_all[Ct : Ct + W] if W else None
+    setup_lde_flat = setup.setup_lde.reshape(Ct + K + TW, N)
+    sigma_lde_flat = setup_lde_flat[:Ct]
+    const_lde_flat = setup_lde_flat[Ct : Ct + K]
+    table_lde_flat = setup_lde_flat[Ct + K :]
     xs_lde = _domain_xs_brev(log_n, L)
     # L_0(x) = (x^n - 1) / (n (x - 1))
     zh = gf.sub(
@@ -156,9 +193,8 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         gf.mul(zh, jnp.uint64(gl.inv(n))),
         gf.batch_inverse(gf.sub(xs_lde, jnp.uint64(1))),
     )
-    z_lde = tuple(
-        lde_from_monomial(s2_mono[i], L).reshape(N) for i in (0, 1)
-    )
+    s2_lde_flat = s2_lde.reshape(-1, N)
+    z_lde = (s2_lde_flat[0], s2_lde_flat[1])
     omega = gl.omega(log_n)
     z_shift_mono = (
         distribute_powers(s2_mono[0], omega),
@@ -167,17 +203,14 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     z_shift_lde = tuple(
         lde_from_monomial(z_shift_mono[i], L).reshape(N) for i in (0, 1)
     )
-    partial_ldes = []
-    for j in range(len(partials)):
-        p_lde = tuple(
-            lde_from_monomial(s2_mono[2 + 2 * j + i], L).reshape(N)
-            for i in (0, 1)
-        )
-        partial_ldes.append(p_lde)
+    partial_ldes = [
+        (s2_lde_flat[2 + 2 * j], s2_lde_flat[3 + 2 * j])
+        for j in range(num_partials)
+    ]
 
     alpha_iter = alpha_powers_iter(alpha)
     acc = gate_terms_contribution(
-        assembly, setup.selector_paths, copy_lde_flat, wit_lde_flat,
+        assembly, setup.selector_paths, copy_lde_flat[:Cg], gate_wit_lde,
         const_lde_flat, setup.selector_depth, alpha_iter, (N,),
     )
     cp_acc = copy_permutation_quotient_terms(
@@ -186,6 +219,22 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         alpha_iter,
     )
     acc = cp_acc if acc is None else ext_f.add(acc, cp_acc)
+    if lookups:
+        ab_off = 2 + 2 * num_partials
+        a_ldes = [
+            (s2_lde_flat[ab_off + 2 * i], s2_lde_flat[ab_off + 2 * i + 1])
+            for i in range(lp.num_repetitions)
+        ]
+        b_lde = (
+            s2_lde_flat[ab_off + 2 * lp.num_repetitions],
+            s2_lde_flat[ab_off + 2 * lp.num_repetitions + 1],
+        )
+        lk_acc = lookup_quotient_terms(
+            a_ldes, b_lde, copy_lde_flat[Cg:], const_lde_flat[K - 1],
+            table_lde_flat, wit_lde_all[Ct + W], lookup_beta, lookup_gamma,
+            lp.num_repetitions, lp.width, alpha_iter,
+        )
+        acc = ext_f.add(acc, lk_acc)
     zh_inv = _vanishing_inv_brev(log_n, L)
     T = (gf.mul(acc[0], zh_inv), gf.mul(acc[1], zh_inv))
     # interpolate over the full LDE coset to monomial form
@@ -205,7 +254,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     t.witness_merkle_tree_cap(q_tree.get_cap())
     z_chal = t.get_ext_challenge()
 
-    # ---- round 4: evaluations at z ---------------------------------------
+    # ---- round 4: evaluations at z (and z*omega, 0) ----------------------
     all_mono = jnp.concatenate([wit_mono, setup.setup_monomials, s2_mono, q_mono])
     B = all_mono.shape[0]
     z_pows = ext_powers_device(z_chal, n)
@@ -219,18 +268,31 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     values_at_z_omega = [
         (int(a), int(b)) for a, b in zip(np.asarray(evw0), np.asarray(evw1))
     ]
+    # lookup sum openings at 0: ext value of each A_i/B pair is the pair of
+    # constant monomial coefficients
+    values_at_0 = []
+    if lookups:
+        s2_mono_host = np.asarray(s2_mono[:, 0])
+        ab_off = 2 + 2 * num_partials
+        for i in range(lp.num_repetitions + 1):
+            values_at_0.append(
+                (int(s2_mono_host[ab_off + 2 * i]),
+                 int(s2_mono_host[ab_off + 2 * i + 1]))
+            )
     for v in values_at_z:
         t.witness_field_elements(v)
     for v in values_at_z_omega:
+        t.witness_field_elements(v)
+    for v in values_at_0:
         t.witness_field_elements(v)
     deep_ch = t.get_ext_challenge()
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
     all_lde_flat = jnp.concatenate(
         [
-            wit_lde.reshape(C + W, N),
+            wit_lde_all,
             setup_lde_flat,
-            s2_lde.reshape(-1, N),
+            s2_lde_flat,
             q_lde.reshape(2 * L, N),
         ]
     )
@@ -254,16 +316,28 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         term = ext_f.mul(ext_f.mul(num, inv_xz), ch)
         h = term if h is None else ext_f.add(h, term)
     # z-poly at z*omega
-    s2_flat = s2_lde.reshape(-1, N)
     for i in range(2):
         ch = ext_scalar(next(ch_iter))
         y = values_at_z_omega[i]
         num = (
-            gf.sub(s2_flat[i], jnp.uint64(y[0])),
+            gf.sub(s2_lde_flat[i], jnp.uint64(y[0])),
             jnp.broadcast_to(jnp.uint64(gl.neg(y[1])), xs_lde.shape),
         )
         term = ext_f.mul(ext_f.mul(num, inv_xzw), ch)
         h = ext_f.add(h, term)
+    # lookup A_i/B at 0: (f(x) - f(0)) / x with f as ext coordinate pair
+    if lookups:
+        inv_x = gf.batch_inverse(xs_lde)
+        ab_off = 2 + 2 * num_partials
+        for i in range(lp.num_repetitions + 1):
+            ch = ext_scalar(next(ch_iter))
+            v0, v1 = values_at_0[i]
+            num = (
+                gf.sub(s2_lde_flat[ab_off + 2 * i], jnp.uint64(v0)),
+                gf.sub(s2_lde_flat[ab_off + 2 * i + 1], jnp.uint64(v1)),
+            )
+            term = ext_f.mul((gf.mul(num[0], inv_x), gf.mul(num[1], inv_x)), ch)
+            h = ext_f.add(h, term)
     # public input openings: (w_col(x) - value) / (x - w^row)
     if assembly.public_inputs:
         pi_points = [gl.pow_(omega, r) for (_c, r, _v) in assembly.public_inputs]
@@ -272,7 +346,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         )
         for k, (col, _row, value) in enumerate(assembly.public_inputs):
             ch = ext_scalar(next(ch_iter))
-            num = gf.sub(wit_lde.reshape(C + W, N)[col], jnp.uint64(value))
+            num = gf.sub(wit_lde_all[col], jnp.uint64(value))
             term_base = gf.mul(num, denoms[k])
             h = ext_f.add(h, (gf.mul(term_base, ch[0]), gf.mul(term_base, ch[1])))
 
@@ -281,9 +355,6 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
 
     # ---- queries ----------------------------------------------------------
     bs = BitSource(log_full)
-    wit_leaves = wit_lde.reshape(C + W, N)
-    setup_leaves = setup_lde_flat
-    s2_leaves = s2_flat
     q_leaves = q_lde.reshape(2 * L, N)
     queries = []
     for _ in range(config.num_queries):
@@ -306,10 +377,10 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
             fidx >>= 1
         queries.append(
             SingleRoundQueries(
-                witness=oq(wit_leaves, wit_tree, idx),
-                stage2=oq(s2_leaves, s2_tree, idx),
+                witness=oq(wit_lde_all, wit_tree, idx),
+                stage2=oq(s2_lde_flat, s2_tree, idx),
                 quotient=oq(q_leaves, q_tree, idx),
-                setup=oq(setup_leaves, setup.setup_tree, idx),
+                setup=oq(setup_lde_flat, setup.setup_tree, idx),
                 fri=fri_qs,
             )
         )
@@ -321,7 +392,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         quotient_cap=q_tree.get_cap(),
         values_at_z=values_at_z,
         values_at_z_omega=values_at_z_omega,
-        values_at_0=[],
+        values_at_0=values_at_0,
         fri_caps=[tr.get_cap() for tr in fri.trees],
         final_fri_monomials=fri.final_monomials,
         queries=queries,

@@ -40,4 +40,55 @@ def check_if_satisfied(assembly, verbose: bool = False) -> bool:
                             f"instance {inst} term {ti} = {term}"
                         )
                     return False
+    if assembly.lookups_enabled:
+        if not _check_lookups(assembly, verbose):
+            return False
+    return True
+
+
+def _check_lookups(assembly, verbose: bool) -> bool:
+    """Every placed lookup tuple is a table row and the multiplicity column
+    counts exactly the placed tuples (reference satisfiability_test.rs lookup
+    spot checks)."""
+    lp = assembly.lookup_params
+    R, w = lp.num_repetitions, lp.width
+    n = assembly.trace_len
+    vals = assembly.lookup_cols_values
+    tid_col = assembly.lookup_table_id_col
+    counts = {}
+    for row in range(n):
+        tid = int(tid_col[row])
+        if tid == 0:
+            if verbose:
+                print(f"LOOKUP: row {row} has no table id")
+            return False
+        table = assembly.lookup_tables[tid - 1]
+        for s in range(R):
+            tup = tuple(int(vals[s * w + j, row]) for j in range(table.width))
+            try:
+                ridx = table.row_index(tup)
+            except (KeyError, AssertionError):
+                if verbose:
+                    print(
+                        f"LOOKUP UNSATISFIED: row {row} sub-arg {s} tuple "
+                        f"{tup} not in table {table.name}"
+                    )
+                return False
+            for j in range(table.width, w):
+                if int(vals[s * w + j, row]) != 0:
+                    if verbose:
+                        print(f"LOOKUP: row {row} sub-arg {s} pad not zero")
+                    return False
+            key = (tid, ridx)
+            counts[key] = counts.get(key, 0) + 1
+    for (tid, ridx), cnt in counts.items():
+        gidx = assembly.table_offsets[tid] + ridx
+        if int(assembly.multiplicities[gidx]) != cnt:
+            if verbose:
+                print(
+                    f"LOOKUP UNSATISFIED: multiplicity of table {tid} row "
+                    f"{ridx}: column says {int(assembly.multiplicities[gidx])},"
+                    f" trace has {cnt}"
+                )
+            return False
     return True

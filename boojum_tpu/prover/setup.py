@@ -149,6 +149,15 @@ class VerificationKey:
             "setup_merkle_cap": [list(c) for c in self.setup_merkle_cap],
             "num_copy_cols": self.num_copy_cols,
             "num_wit_cols": self.num_wit_cols,
+            "num_lookup_tables": self.num_lookup_tables,
+            "lookup_params": None
+            if self.lookup_params is None
+            else {
+                "width": self.lookup_params.width,
+                "num_repetitions": self.lookup_params.num_repetitions,
+                "share_table_id": self.lookup_params.share_table_id,
+                "use_specialized_columns": self.lookup_params.use_specialized_columns,
+            },
             "geometry": {
                 "num_columns_under_copy_permutation": self.geometry.num_columns_under_copy_permutation,
                 "num_witness_columns": self.geometry.num_witness_columns,
@@ -175,12 +184,11 @@ class SetupData:
 
 
 def generate_setup(assembly, config) -> SetupData:
-    """Full setup: sigmas + constants -> monomial -> LDE -> Merkle -> VK."""
-    if assembly.lookup_params.is_enabled or assembly.lookup_rows:
-        raise NotImplementedError(
-            "lookup argument not wired into setup/prover yet; "
-            "do not use enforce_lookup/perform_lookup"
-        )
+    """Full setup: sigmas + constants -> monomial -> LDE -> Merkle -> VK.
+
+    Setup column order: [sigma (C_total) | constants (K, + table-id col when
+    lookups are on) | stacked table columns (width+1, lookups only)].
+    """
     n = assembly.trace_len
     assert config.fri_final_degree < n, (
         "fri_final_degree must be below the trace length (at least one fold)"
@@ -199,9 +207,20 @@ def generate_setup(assembly, config) -> SetupData:
         assembly.geometry.max_allowed_constraint_degree + 1
         <= config.fri_lde_factor
     ), "copy-permutation chunk degree exceeds fri_lde_factor"
-    sigma = compute_sigma_values(assembly.copy_placement, n)
+    full_placement = np.concatenate(
+        [assembly.copy_placement, assembly.lookup_placement], axis=0
+    )
+    sigma = compute_sigma_values(full_placement, n)
     consts = build_constant_columns(assembly, selector_paths)
-    setup_cols = np.concatenate([sigma, consts], axis=0)
+    if assembly.lookups_enabled:
+        consts = np.concatenate(
+            [consts, assembly.lookup_table_id_col[None, :]], axis=0
+        )
+        table_cols = assembly.stacked_table_columns(assembly.lookup_params.width)
+        setup_cols = np.concatenate([sigma, consts, table_cols], axis=0)
+    else:
+        table_cols = np.zeros((0, n), dtype=np.uint64)
+        setup_cols = np.concatenate([sigma, consts], axis=0)
     dev = jnp.asarray(setup_cols)
     monomials = monomial_from_values(dev)
     lde = lde_from_monomial(monomials, config.fri_lde_factor)
@@ -220,9 +239,9 @@ def generate_setup(assembly, config) -> SetupData:
         selector_paths=selector_paths,
         public_input_locations=[(c, r) for (c, r, _v) in assembly.public_inputs],
         setup_merkle_cap=tree.get_cap(),
-        num_copy_cols=assembly.copy_placement.shape[0],
+        num_copy_cols=sigma.shape[0],
         num_wit_cols=assembly.wit_placement.shape[0],
-        lookup_params=assembly.lookup_params,
+        lookup_params=assembly.lookup_params if assembly.lookups_enabled else None,
         num_lookup_tables=len(assembly.lookup_tables),
     )
     return SetupData(

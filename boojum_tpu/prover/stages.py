@@ -206,6 +206,82 @@ def gate_terms_contribution(
     return acc
 
 
+def aggregate_lookup_columns(cols, table_id_col, gamma, beta):
+    """Σ_j γ^j·col_j (+ γ^w·table_id) + β over whole base arrays -> ext pair.
+
+    cols: list of (n,)-or-(N,) base arrays; table_id_col: same-shape base
+    array or None; returns the log-derivative denominator before inversion
+    (reference lookup_argument_in_ext.rs:424 'aggregated_lookup_columns').
+    """
+    total = len(cols) + (1 if table_id_col is not None else 0)
+    gpow = ext_f.powers_s(gamma, total)
+    b = ext_scalar(beta)
+    acc0 = jnp.broadcast_to(b[0], cols[0].shape)
+    acc1 = jnp.broadcast_to(b[1], cols[0].shape)
+    seq = list(cols) + ([table_id_col] if table_id_col is not None else [])
+    for j, col in enumerate(seq):
+        g0, g1 = jnp.uint64(gpow[j][0]), jnp.uint64(gpow[j][1])
+        acc0 = gf.add(acc0, gf.mul(col, g0))
+        acc1 = gf.add(acc1, gf.mul(col, g1))
+    return (acc0, acc1)
+
+
+def compute_lookup_polys(
+    lookup_cols, table_id_col, table_cols, multiplicities,
+    lookup_beta, lookup_gamma, num_repetitions, width,
+):
+    """A_i and B polys over H (reference compute_lookup_poly_pairs_specialized,
+    lookup_argument_in_ext.rs:320).
+
+    lookup_cols: (R*w, n) base device array of the specialized columns;
+    table_id_col: (n,) base; table_cols: (w+1, n) stacked tables incl. id;
+    multiplicities: (n,). Returns (a_polys list of ext pairs, b_poly ext pair):
+      A_i(x) = 1 / (Σ_j γ^j·w_{i,j}(x) + γ^w·table_id(x) + β)
+      B(x)   = M(x) / (Σ_j γ^j·t_j(x) + γ^w·t_id(x) + β)
+    """
+    a_polys = []
+    for i in range(num_repetitions):
+        cols = [lookup_cols[i * width + j] for j in range(width)]
+        den = aggregate_lookup_columns(cols, table_id_col, lookup_gamma, lookup_beta)
+        a_polys.append(ext_f.batch_inverse(den))
+    t_den = aggregate_lookup_columns(
+        [table_cols[j] for j in range(width)], table_cols[width],
+        lookup_gamma, lookup_beta,
+    )
+    t_inv = ext_f.batch_inverse(t_den)
+    b_poly = (gf.mul(t_inv[0], multiplicities), gf.mul(t_inv[1], multiplicities))
+    return a_polys, b_poly
+
+
+def lookup_quotient_terms(
+    a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
+    lookup_beta, lookup_gamma, num_repetitions, width, alpha_iter,
+):
+    """Quotient contributions over the LDE domain (reference
+    compute_quotient_terms_for_lookup_specialized,
+    lookup_argument_in_ext.rs:949):
+
+      per sub-arg i: A_i(x)·(Σ γ^j·w_{i,j}(x) + γ^w·tid(x) + β) − 1
+      for B:         B(x)·(Σ γ^j·t_j(x) + γ^w·t_id(x) + β) − M(x)
+    """
+    acc = None
+    one = jnp.uint64(1)
+    for i in range(num_repetitions):
+        cols = [lookup_lde_cols[i * width + j] for j in range(width)]
+        den = aggregate_lookup_columns(cols, table_id_lde, lookup_gamma, lookup_beta)
+        term = ext_f.mul(a_ldes[i], den)
+        term = (gf.sub(term[0], jnp.broadcast_to(one, term[0].shape)), term[1])
+        acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+    t_den = aggregate_lookup_columns(
+        [table_ldes[j] for j in range(width)], table_ldes[width],
+        lookup_gamma, lookup_beta,
+    )
+    term = ext_f.mul(b_lde, t_den)
+    term = (gf.sub(term[0], mult_lde), term[1])
+    acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+    return acc
+
+
 def copy_permutation_quotient_terms(
     z_lde, z_shift_lde, partial_ldes, chunks, copy_lde, sigma_lde,
     non_residues, xs_lde, l0_lde, beta, gamma, alpha_iter,

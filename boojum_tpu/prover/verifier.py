@@ -3,9 +3,10 @@
 Counterpart of `/root/reference/src/cs/implementations/verifier.rs:888`:
 transcript replay, quotient reconstruction at z via the same gate evaluators
 (over ExtScalarOps — the verifier-side face of the field-like contract),
-copy-permutation relations at z, and DEEP/FRI query checking against Merkle
-caps. Pure python ints: the verifier is tiny compared to proving and needs no
-device.
+copy-permutation and log-derivative lookup relations at z, the lookup
+sum check over the openings at 0 (verifier.rs:1242), and DEEP/FRI query
+checking against Merkle caps. Pure python ints: the verifier is tiny compared
+to proving and needs no device.
 """
 
 from __future__ import annotations
@@ -57,18 +58,31 @@ def verify(vk, proof, gates) -> bool:
     L = vk.fri_lde_factor
     log_full = log_n + (L.bit_length() - 1)
     N = n * L
-    C = vk.num_copy_cols
+    Ct = vk.num_copy_cols  # ALL columns under copy permutation
+    Cg = geometry.num_columns_under_copy_permutation
     W = vk.num_wit_cols
-    K = geometry.num_constant_columns
+    lp = vk.lookup_params
+    lookups = lp is not None and lp.is_enabled
+    M = 1 if lookups else 0
+    R = lp.num_repetitions if lookups else 0
+    wdt = lp.width if lookups else 0
+    K = geometry.num_constant_columns + (1 if lookups else 0)
+    TW = (wdt + 1) if lookups else 0
+    if not lookups and Ct != Cg:
+        return False
+    if lookups and Ct != Cg + R * wdt:
+        return False
     if [g.name for g in gates] != list(vk.gate_names):
         return False
     if len(proof.public_inputs) != len(vk.public_input_locations):
         return False
 
-    num_chunks = len(chunk_columns(C, geometry.max_allowed_constraint_degree))
-    S = 2 * (1 + (num_chunks - 1))  # z + partials, 2 base cols each
-    B = (C + W) + (C + K) + S + 2 * L
+    num_chunks = len(chunk_columns(Ct, geometry.max_allowed_constraint_degree))
+    S = 2 * (1 + (num_chunks - 1)) + 2 * R + 2 * M  # z, partials, A_i, B
+    B = (Ct + W + M) + (Ct + K + TW) + S + 2 * L
     if len(proof.values_at_z) != B or len(proof.values_at_z_omega) != 2:
+        return False
+    if len(proof.values_at_0) != R + M:
         return False
 
     # ---- transcript replay ------------------------------------------------
@@ -78,6 +92,9 @@ def verify(vk, proof, gates) -> bool:
     t.witness_merkle_tree_cap(proof.witness_cap)
     beta = t.get_ext_challenge()
     gamma = t.get_ext_challenge()
+    if lookups:
+        lookup_beta = t.get_ext_challenge()
+        lookup_gamma = t.get_ext_challenge()
     t.witness_merkle_tree_cap(proof.stage2_cap)
     alpha = t.get_ext_challenge()
     t.witness_merkle_tree_cap(proof.quotient_cap)
@@ -85,6 +102,8 @@ def verify(vk, proof, gates) -> bool:
     for v in proof.values_at_z:
         t.witness_field_elements(v)
     for v in proof.values_at_z_omega:
+        t.witness_field_elements(v)
+    for v in proof.values_at_0:
         t.witness_field_elements(v)
     deep_ch = t.get_ext_challenge()
     # FRI replay — ALL security parameters come from the VK, never the proof
@@ -104,8 +123,6 @@ def verify(vk, proof, gates) -> bool:
         if r < len(proof.fri_caps):
             t.witness_merkle_tree_cap(proof.fri_caps[r])
         fri_challenges.append(t.get_ext_challenge())
-    # reorder: caps are absorbed before each challenge; prover absorbs cap r
-    # then draws challenge r, commits cap r+1 from the fold, etc.
     if len(proof.final_fri_monomials) != (n >> num_folds):
         return False
     for c0, c1 in proof.final_fri_monomials:
@@ -113,11 +130,12 @@ def verify(vk, proof, gates) -> bool:
 
     # ---- split openings ---------------------------------------------------
     vals = [tuple(v) for v in proof.values_at_z]
-    wit_vals = vals[: C + W]
-    sigma_vals = vals[C + W : C + W + C]
-    const_vals = vals[C + W + C : C + W + C + K]
-    s2_vals = vals[C + W + C + K : C + W + C + K + S]
-    q_vals = vals[C + W + C + K + S :]
+    wit_vals = vals[: Ct + W + M]
+    sigma_vals = vals[Ct + W + M : 2 * Ct + W + M]
+    const_vals = vals[2 * Ct + W + M : 2 * Ct + W + M + K]
+    table_vals = vals[2 * Ct + W + M + K : 2 * Ct + W + M + K + TW]
+    s2_vals = vals[2 * Ct + W + M + K + TW : 2 * Ct + W + M + K + TW + S]
+    q_vals = vals[2 * Ct + W + M + K + TW + S :]
 
     # ---- quotient identity at z ------------------------------------------
     alpha_pows = _powers_iter(alpha)
@@ -136,7 +154,7 @@ def verify(vk, proof, gates) -> bool:
         for inst in range(reps):
             row = _ZRowView(
                 wit_vals, const_vals, inst * gate.principal_width,
-                inst * gate.witness_width, depth, C,
+                inst * gate.witness_width, depth, Ct,
             )
             dst = TermsCollector()
             gate.evaluate(ExtScalarOps, row, dst)
@@ -157,8 +175,8 @@ def verify(vk, proof, gates) -> bool:
         ext_from_pair(s2_vals[2 + 2 * j], s2_vals[3 + 2 * j])
         for j in range(num_chunks - 1)
     ]
-    non_residues = non_residues_for_copy_permutation(C)
-    chunks = chunk_columns(C, geometry.max_allowed_constraint_degree)
+    non_residues = non_residues_for_copy_permutation(Ct)
+    chunks = chunk_columns(Ct, geometry.max_allowed_constraint_degree)
     # L_0(z) = (z^n - 1)/(n (z - 1))
     z_pow_n = ext_f.pow_s(z_chal, n)
     zh_at_z = ext_f.sub_s(z_pow_n, ext_f.ONE_S)
@@ -186,6 +204,38 @@ def verify(vk, proof, gates) -> bool:
             ext_f.mul_s(lhs_seq[j], den_p), ext_f.mul_s(rhs_seq[j], num_p)
         )
         total = ext_f.add_s(total, ext_f.mul_s(rel, next(alpha_pows)))
+
+    # lookup terms at z (A_i·den − 1, B·den_t − M) + the sum check at 0
+    if lookups:
+        ab_off = 2 * (1 + (num_chunks - 1))
+        gpow = ext_f.powers_s(lookup_gamma, wdt + 1)
+        tid_at_z = const_vals[K - 1]
+        for i in range(R):
+            a_i = ext_from_pair(
+                s2_vals[ab_off + 2 * i], s2_vals[ab_off + 2 * i + 1]
+            )
+            den = lookup_beta
+            for j in range(wdt):
+                wv = wit_vals[Cg + i * wdt + j]
+                den = ext_f.add_s(den, ext_f.mul_s(gpow[j], wv))
+            den = ext_f.add_s(den, ext_f.mul_s(gpow[wdt], tid_at_z))
+            rel = ext_f.sub_s(ext_f.mul_s(a_i, den), ext_f.ONE_S)
+            total = ext_f.add_s(total, ext_f.mul_s(rel, next(alpha_pows)))
+        b_at_z = ext_from_pair(
+            s2_vals[ab_off + 2 * R], s2_vals[ab_off + 2 * R + 1]
+        )
+        den = lookup_beta
+        for j in range(wdt + 1):
+            den = ext_f.add_s(den, ext_f.mul_s(gpow[j], table_vals[j]))
+        m_at_z = wit_vals[Ct + W]
+        rel = ext_f.sub_s(ext_f.mul_s(b_at_z, den), m_at_z)
+        total = ext_f.add_s(total, ext_f.mul_s(rel, next(alpha_pows)))
+        # sum over H of (sum_i A_i - B) must vanish:  sum_i A_i(0) == B(0)
+        a_sum = ext_f.ZERO_S
+        for i in range(R):
+            a_sum = ext_f.add_s(a_sum, tuple(proof.values_at_0[i]))
+        if tuple(a_sum) != tuple(proof.values_at_0[R]):
+            return False
 
     # T(z) from quotient chunks: sum z^{i n} * q_i(z)
     t_at_z = ext_f.ZERO_S
@@ -226,8 +276,8 @@ def verify(vk, proof, gates) -> bool:
         ):
             return False
         if (
-            len(q.witness.leaf_values) != C + W
-            or len(q.setup.leaf_values) != C + K
+            len(q.witness.leaf_values) != Ct + W + M
+            or len(q.setup.leaf_values) != Ct + K + TW
             or len(q.stage2.leaf_values) != S
             or len(q.quotient.leaf_values) != 2 * L
         ):
@@ -257,6 +307,19 @@ def verify(vk, proof, gates) -> bool:
             h = ext_f.add_s(
                 h, ext_f.mul_s(ext_f.mul_s(diff, inv_xzw), next(ch_iter))
             )
+        if lookups:
+            inv_x = gl.inv(x)
+            ab_off = 2 * (1 + (num_chunks - 1))
+            for i in range(R + 1):
+                ch = next(ch_iter)
+                f_pair = (
+                    q.stage2.leaf_values[ab_off + 2 * i],
+                    q.stage2.leaf_values[ab_off + 2 * i + 1],
+                )
+                diff = ext_f.sub_s(f_pair, tuple(proof.values_at_0[i]))
+                h = ext_f.add_s(
+                    h, ext_f.mul_s(ext_f.mul_by_base_s(diff, inv_x), ch)
+                )
         for k, (col, row) in enumerate(pi_locs):
             ch = next(ch_iter)
             pt = gl.pow_(omega, row)

@@ -1,0 +1,85 @@
+"""Gadget-layer tests: Boolean / Num / UIntX semantics + satisfiability
+(reference test model: per-gadget witness_hook parity + check_if_satisfied)."""
+
+import numpy as np
+
+from boojum_tpu.cs.types import CSGeometry, LookupParameters
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.gadgets import Boolean, Num, UInt8, UInt32
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+from boojum_tpu.field import gl
+
+GEOM = CSGeometry(
+    num_columns_under_copy_permutation=16,
+    num_witness_columns=0,
+    num_constant_columns=8,
+    max_allowed_constraint_degree=4,
+)
+
+LOOKUP = LookupParameters(width=4, num_repetitions=2)
+
+
+def mk_cs(lookups=False):
+    return ConstraintSystem(
+        GEOM, 1 << 13, lookup_params=LOOKUP if lookups else None
+    )
+
+
+def test_boolean_ops():
+    cs = mk_cs()
+    vals = [(a, b) for a in (0, 1) for b in (0, 1)]
+    for av, bv in vals:
+        a = Boolean.allocate(cs, bool(av))
+        b = Boolean.allocate(cs, bool(bv))
+        assert a.and_(cs, b).get_value(cs) == bool(av and bv)
+        assert a.or_(cs, b).get_value(cs) == bool(av or bv)
+        assert a.xor(cs, b).get_value(cs) == bool(av ^ bv)
+        assert a.negate(cs).get_value(cs) == (not av)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_num_ops():
+    cs = mk_cs()
+    rng = np.random.default_rng(3)
+    for _ in range(10):
+        x = int(rng.integers(0, gl.P, dtype=np.uint64))
+        y = int(rng.integers(0, gl.P, dtype=np.uint64))
+        a, b = Num.allocate(cs, x), Num.allocate(cs, y)
+        assert a.add(cs, b).get_value(cs) == (x + y) % gl.P
+        assert a.sub(cs, b).get_value(cs) == (x - y) % gl.P
+        assert a.mul(cs, b).get_value(cs) == (x * y) % gl.P
+        assert a.equals(cs, b).get_value(cs) == (x == y)
+        assert a.equals(cs, Num.allocate(cs, x)).get_value(cs)
+    lc = Num.linear_combination(
+        cs, [Num.allocate(cs, 5), Num.allocate(cs, 7), Num.allocate(cs, 11),
+             Num.allocate(cs, 13)], [1, 2, 3, 4]
+    )
+    assert lc.get_value(cs) == 5 + 14 + 33 + 52
+    bits = Num.allocate(cs, 0b1011).spread_into_bits(cs, 6)
+    assert [b.get_value(cs) for b in bits] == [True, True, False, True, False, False]
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_uint_ops():
+    cs = mk_cs(lookups=True)
+    a = UInt32.allocate_checked(cs, 0xDEADBEEF)
+    b = UInt32.allocate_checked(cs, 0x12345678)
+    s, cout = a.add(cs, b)
+    assert s.get_value(cs) == (0xDEADBEEF + 0x12345678) & 0xFFFFFFFF
+    assert cout.get_value(cs) == ((0xDEADBEEF + 0x12345678) >> 32 == 1)
+    d, bout = a.sub(cs, b)
+    assert d.get_value(cs) == (0xDEADBEEF - 0x12345678) & 0xFFFFFFFF
+    assert not bout.get_value(cs)
+    lo, hi = a.fma(cs, b, UInt32.allocate_checked(cs, 7))
+    full = 0xDEADBEEF * 0x12345678 + 7
+    assert lo.get_value(cs) == full & 0xFFFFFFFF
+    assert hi.get_value(cs) == full >> 32
+    bs = [UInt8.allocate_checked(cs, v) for v in (0xDE, 0xAD, 0xBE, 0xEF)]
+    w = UInt32.from_be_bytes(cs, bs)
+    assert w.get_value(cs) == 0xDEADBEEF
+    le = w.to_le_bytes(cs)
+    assert [x.get_value(cs) for x in le] == [0xEF, 0xBE, 0xAD, 0xDE]
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
