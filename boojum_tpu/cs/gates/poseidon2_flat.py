@@ -1,0 +1,179 @@
+"""Poseidon2 flattened gate: one full width-12 permutation per instance.
+
+Counterpart of `/root/reference/src/cs/gates/poseidon2.rs`
+(`Poseidon2RoundFunctionFlattenedEvaluator::evaluate_once`, :180-404): the
+permutation is inscribed across one row — 12 input + 12 output variables plus
+one auxiliary "degree reset" variable at every point where the running state
+expression would exceed the allowed constraint degree (after each full-round
+s-box batch and each partial-round s-box input). Each reset contributes the
+constraint `state_expr - aux = 0` (degree <= 7) and the traversal continues
+from the fresh variable; the final external-MDS output is tied to the output
+variables. Total: 12 + 12 + 106 aux = 130 columns — exactly the 130
+copy-permutation columns of the Era recursion geometry (`vk.json`).
+
+The SAME traversal (`flat_permutation`) drives the constraint evaluator (over
+field-like ops) and the witness resolver (over scalars), so they cannot drift.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+from ...hashes import poseidon2_params as params
+from .base import Gate
+
+SW = 12
+HALF_FULL = 4
+NUM_PARTIAL = 22
+
+_RC = [
+    [int(c) for c in params.ALL_ROUND_CONSTANTS[12 * r : 12 * r + 12]]
+    for r in range(30)
+]
+_DIAG = [int(d) for d in params.M_I_DIAGONAL]
+
+NUM_AUX = (HALF_FULL - 1) * SW + NUM_PARTIAL + HALF_FULL * SW  # 106
+WIDTH = 2 * SW + NUM_AUX  # 130
+
+
+def _ext_mds(ops, s):
+    """circ(2·M4, M4, M4) via the add/double chain (same schedule as
+    boojum_tpu.hashes.poseidon2._external_mds)."""
+
+    def block(x0, x1, x2, x3):
+        t0 = ops.add(x0, x1)
+        t1 = ops.add(x2, x3)
+        t2 = ops.add(ops.double(x1), t1)
+        t3 = ops.add(ops.double(x3), t0)
+        t4 = ops.add(ops.double(ops.double(t1)), t3)
+        t5 = ops.add(ops.double(ops.double(t0)), t2)
+        return ops.add(t3, t5), t5, ops.add(t2, t4), t4
+
+    blocks = [block(*s[4 * b : 4 * b + 4]) for b in range(3)]
+    sums = [
+        ops.add(ops.add(blocks[0][i], blocks[1][i]), blocks[2][i])
+        for i in range(4)
+    ]
+    return [ops.add(blocks[b][i], sums[i]) for b in range(3) for i in range(4)]
+
+
+def _int_mds(ops, s):
+    total = s[0]
+    for v in s[1:]:
+        total = ops.add(total, v)
+    return [
+        ops.add(ops.mul(v, ops.constant(_DIAG[i])), total)
+        for i, v in enumerate(s)
+    ]
+
+
+def _pow7(ops, x):
+    x2 = ops.mul(x, x)
+    x3 = ops.mul(x2, x)
+    return ops.mul(ops.mul(x2, x2), x3)
+
+
+def flat_permutation(ops, state, reset):
+    """Poseidon2 permutation with a `reset(value) -> value` hook at every
+    degree-reset point. Evaluator mode: reset pulls the next aux variable and
+    emits `value - aux`; witness mode: reset records the value."""
+    state = _ext_mds(ops, state)
+    for r in range(HALF_FULL):
+        if r != 0:
+            state = [reset(v) for v in state]
+        state = [
+            _pow7(ops, ops.add(v, ops.constant(_RC[r][i])))
+            for i, v in enumerate(state)
+        ]
+        state = _ext_mds(ops, state)
+    for p in range(NUM_PARTIAL):
+        s0 = ops.add(state[0], ops.constant(_RC[HALF_FULL + p][0]))
+        state[0] = _pow7(ops, reset(s0))
+        state = _int_mds(ops, state)
+    for r in range(HALF_FULL):
+        state = [reset(v) for v in state]
+        rc = _RC[HALF_FULL + NUM_PARTIAL + r]
+        state = [
+            _pow7(ops, ops.add(v, ops.constant(rc[i])))
+            for i, v in enumerate(state)
+        ]
+        state = _ext_mds(ops, state)
+    return state
+
+
+def _witness_trace(input_values):
+    """(outputs, aux_values) of one permutation over scalars."""
+    from ..field_like import ScalarOps
+
+    aux = []
+
+    def reset(v):
+        aux.append(v)
+        return v
+
+    out = flat_permutation(ScalarOps, [v % gl.P for v in input_values], reset)
+    return out, aux
+
+
+class Poseidon2FlattenedGate(Gate):
+    name = "poseidon2_flat"
+    principal_width = WIDTH
+    num_terms = NUM_AUX + SW
+    max_degree = 7
+
+    def evaluate(self, ops, row, dst):
+        state = [row.v(i) for i in range(SW)]
+        output = [row.v(SW + i) for i in range(SW)]
+        cursor = [2 * SW]
+
+        def reset(v):
+            aux = row.v(cursor[0])
+            cursor[0] += 1
+            dst.push(ops.sub(v, aux))
+            return aux
+
+        state = flat_permutation(ops, state, reset)
+        assert cursor[0] == WIDTH
+        for s, o in zip(state, output):
+            dst.push(ops.sub(o, s))
+
+    def padding_instance(self, cs, constants=()):
+        zero = cs.zero_var()
+        ins = [zero] * SW
+        outs, aux = _witness_trace([0] * SW)
+        vals = outs + aux
+        places = cs.alloc_multiple_variables_without_values(len(vals))
+        cs.set_values_with_dependencies(
+            [], list(places), lambda _, vals=vals: list(vals)
+        )
+        return ins + list(places)
+
+    @staticmethod
+    def permutation(cs, input_vars):
+        """Allocate and constrain output = poseidon2(input); returns the 12
+        output variables (the circuit round function's `compute_round_function`,
+        reference poseidon2.rs + gadgets/poseidon2/mod.rs)."""
+        assert len(input_vars) == SW
+        outs = cs.alloc_multiple_variables_without_values(SW)
+        auxs = cs.alloc_multiple_variables_without_values(NUM_AUX)
+
+        def resolve(vals):
+            out, aux = _witness_trace(list(vals))
+            return out + aux
+
+        cs.set_values_with_dependencies(
+            list(input_vars), list(outs) + list(auxs), resolve
+        )
+        cs.place_gate(
+            Poseidon2FlattenedGate.instance(),
+            list(input_vars) + list(outs) + list(auxs),
+            (),
+        )
+        return list(outs)
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst

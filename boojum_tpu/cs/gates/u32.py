@@ -91,31 +91,71 @@ class U32SubGate(Gate):
 
 
 class U32FmaGate(Gate):
-    """a·b + c + carry_in = low + 2^32·high (reference u32_fma.rs;
-    low/high range-checked at the gadget layer)."""
+    """a·b + c + carry_in = low + 2^32·high, made sound in Goldilocks by
+    splitting the operands into 16-bit halves so no single constraint can
+    reach p (the naive one-liner maxes at 2^64-1 > p and admits a second
+    witness shifted by p; the reference splits to 8-bit sub-words for the
+    same reason, u32_fma.rs:73-130).
+
+    Vars: [a, b, c, cin, a_lo, a_hi, b_lo, b_hi, low, high, k]; terms:
+      (1) a − a_lo − 2^16·a_hi
+      (2) b − b_lo − 2^16·b_hi
+      (3) a_lo·b_lo + c + cin + 2^16·(a_lo·b_hi + a_hi·b_lo) − low − 2^32·k
+          (max ≈ 2^50 < p; k is the bounded mid-carry, range-checked ≤ 2^20)
+      (4) a_hi·b_hi + k − high              (max ≈ 2^32 + 2^20 < p)
+    Halves/k are range-checked by the fma() helper; low/high by the caller.
+    """
 
     name = "u32_fma"
-    principal_width = 6
-    num_terms = 1
+    principal_width = 11
+    num_terms = 4
     max_degree = 2
 
     def evaluate(self, ops, row, dst):
-        a, b, c, cin, low, high = (row.v(i) for i in range(6))
-        lhs = ops.add(ops.add(ops.mul(a, b), c), cin)
-        rhs = ops.add(low, ops.mul(ops.constant(SHIFT32), high))
+        a, b, c, cin, a_lo, a_hi, b_lo, b_hi, low, high, k = (
+            row.v(i) for i in range(11)
+        )
+        sh16 = ops.constant(1 << 16)
+        dst.push(
+            ops.sub(a, ops.add(a_lo, ops.mul(sh16, a_hi)))
+        )
+        dst.push(
+            ops.sub(b, ops.add(b_lo, ops.mul(sh16, b_hi)))
+        )
+        mid = ops.add(ops.mul(a_lo, b_hi), ops.mul(a_hi, b_lo))
+        lhs = ops.add(ops.add(ops.mul(a_lo, b_lo), c), cin)
+        lhs = ops.add(lhs, ops.mul(sh16, mid))
+        rhs = ops.add(low, ops.mul(ops.constant(SHIFT32), k))
         dst.push(ops.sub(lhs, rhs))
+        dst.push(ops.sub(ops.add(ops.mul(a_hi, b_hi), k), high))
 
     @staticmethod
     def fma(cs, a, b, c, carry_in):
-        low = cs.alloc_variable_without_value()
-        high = cs.alloc_variable_without_value()
+        outs = cs.alloc_multiple_variables_without_values(7)
+        a_lo, a_hi, b_lo, b_hi, low, high, k = outs
 
         def resolve(vals):
-            s = vals[0] * vals[1] + vals[2] + vals[3]
-            return [s & 0xFFFFFFFF, s >> 32]
+            av, bv, cv, cinv = vals
+            s = av * bv + cv + cinv
+            alo, ahi = av & 0xFFFF, av >> 16
+            blo, bhi = bv & 0xFFFF, bv >> 16
+            part = alo * blo + cv + cinv + ((alo * bhi + ahi * blo) << 16)
+            return [
+                alo, ahi, blo, bhi,
+                s & 0xFFFFFFFF, s >> 32, part >> 32,
+            ]
 
-        cs.set_values_with_dependencies([a, b, c, carry_in], [low, high], resolve)
-        cs.place_gate(U32FmaGate.instance(), [a, b, c, carry_in, low, high], ())
+        cs.set_values_with_dependencies([a, b, c, carry_in], list(outs), resolve)
+        cs.place_gate(
+            U32FmaGate.instance(),
+            [a, b, c, carry_in, a_lo, a_hi, b_lo, b_hi, low, high, k],
+            (),
+        )
+        from ...gadgets.chunk_utils import decompose_and_check
+
+        for half in (a_lo, a_hi, b_lo, b_hi):
+            decompose_and_check(cs, half, 16)
+        decompose_and_check(cs, k, 20)
         return low, high
 
     _inst = None
@@ -154,6 +194,57 @@ class U32TriAddCarryAsChunkGate(Gate):
         cs.set_values_with_dependencies([a, b, c], [low, high], resolve)
         cs.place_gate(U32TriAddCarryAsChunkGate.instance(), [a, b, c, low, high], ())
         return low, high
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
+class ByteTriAddGate(Gate):
+    """Three u32 operands as LE byte chunks: Σ_i (a_i+b_i+x_i)·2^{8i} =
+    Σ_i out_i·2^{8i} + 2^32·carry (the chunked form the reference gate
+    u32_tri_add_carry_as_chunk.rs actually uses — operands never get
+    composed; out bytes and the carry chunk are range-checked by the
+    caller's follow-up lookups)."""
+
+    name = "byte_tri_add"
+    principal_width = 17
+    num_terms = 1
+    max_degree = 1
+
+    def evaluate(self, ops, row, dst):
+        acc = None
+        for i in range(4):
+            w = ops.constant(1 << (8 * i))
+            s = ops.add(ops.add(row.v(i), row.v(4 + i)), row.v(8 + i))
+            s = ops.sub(s, row.v(12 + i))
+            term = ops.mul(w, s)
+            acc = term if acc is None else ops.add(acc, term)
+        acc = ops.sub(acc, ops.mul(ops.constant(SHIFT32), row.v(16)))
+        dst.push(acc)
+
+    @staticmethod
+    def add(cs, a4, b4, x4):
+        """(out4, carry): bytes of (a + b + x) mod 2^32 plus the carry chunk."""
+        outs = cs.alloc_multiple_variables_without_values(4)
+        carry = cs.alloc_variable_without_value()
+        ins = list(a4) + list(b4) + list(x4)
+
+        def resolve(vals):
+            s = sum(v << (8 * i) for i, v in enumerate(vals[0:4]))
+            s += sum(v << (8 * i) for i, v in enumerate(vals[4:8]))
+            s += sum(v << (8 * i) for i, v in enumerate(vals[8:12]))
+            return [(s >> (8 * i)) & 0xFF for i in range(4)] + [s >> 32]
+
+        cs.set_values_with_dependencies(ins, list(outs) + [carry], resolve)
+        cs.place_gate(
+            ByteTriAddGate.instance(), ins + list(outs) + [carry], ()
+        )
+        return list(outs), carry
 
     _inst = None
 

@@ -56,6 +56,7 @@ class ConstraintSystem:
         self.public_inputs: list[tuple[int, int]] = []
         self._zero_var = None
         self._one_var = None
+        self._constants_cache: dict[int, int] = {}
         # lookups (specialized columns mode)
         self.lookup_tables = []  # list of LookupTable
         self._table_by_name = {}
@@ -97,16 +98,36 @@ class ConstraintSystem:
 
     def zero_var(self) -> int:
         if self._zero_var is None:
-            self._zero_var = ConstantsAllocatorGate.allocate_constant(self, 0)
+            self._zero_var = self.allocate_constant(0)
         return self._zero_var
 
     def one_var(self) -> int:
         if self._one_var is None:
-            self._one_var = ConstantsAllocatorGate.allocate_constant(self, 1)
+            self._one_var = self.allocate_constant(1)
         return self._one_var
 
     def allocate_constant(self, value: int) -> int:
-        return ConstantsAllocatorGate.allocate_constant(self, value)
+        """Allocate (or reuse) a variable pinned to a constant. Same-value
+        requests return the same variable — the copy-permutation makes reuse
+        free, and hash gadgets re-request the same round constants heavily
+        (the reference amortizes these per-row via tooling instead,
+        constant_allocator.rs)."""
+        value = value % gl.P
+        v = self._constants_cache.get(value)
+        if v is None:
+            v = ConstantsAllocatorGate.allocate_constant(self, value)
+            self._constants_cache[value] = v
+        return v
+
+    def has_table(self, name: str) -> bool:
+        return name in self._table_by_name
+
+    def ensure_table(self, name: str, builder) -> int:
+        """Register the table built by `builder()` unless already present;
+        returns its table id."""
+        if name not in self._table_by_name:
+            self.add_lookup_table(builder())
+        return self._table_by_name[name]
 
     # ------------------------------------------------------------------
     # gate placement (reference implementations/cs.rs:427)

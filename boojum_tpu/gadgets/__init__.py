@@ -5,6 +5,8 @@ from .tables import (
     split4bit_table,
 )
 from .sha256 import sha256, sha256_digest_bytes, allocate_u8_input
+from .keccak256 import keccak256, keccak256_digest_bytes
+from .blake2s import blake2s, blake2s_digest_bytes
 from .boolean import Boolean
 from .num import Num
 from .uint import UInt8, UInt16, UInt32

@@ -15,15 +15,22 @@ MASK4 = 0xF
 
 
 def ensure_trixor(cs) -> int:
-    t = trixor4_table()
-    if t.name not in cs._table_by_name:
-        cs.add_lookup_table(t)
-    return cs.get_table_id(t.name)
+    return cs.ensure_table("trixor4", trixor4_table)
 
 
 def range_check_chunks_batched(cs, chunks, table_id=None):
-    """4-bit membership checks through TriXor4, three chunks per lookup."""
+    """4-bit membership checks through TriXor4, three chunks per lookup.
+
+    When the CS has no lookup argument configured, falls back to boolean bit
+    decomposition (4 booleans + a recomposition per chunk) so range-checked
+    gadgets stay usable in lookup-free circuits."""
     if not chunks:
+        return
+    if not cs.lookup_params.is_enabled:
+        from .num import Num
+
+        for c in chunks:
+            Num(c).spread_into_bits(cs, 4)
         return
     if table_id is None:
         table_id = ensure_trixor(cs)

@@ -1,0 +1,181 @@
+"""Circuit-variable face of the field-like ops contract.
+
+Counterpart of the reference's `NumAsFieldWrapper` / `NumExtAsFieldWrapper`
+(`/root/reference/src/gadgets/num/prime_field_like.rs`): the same ops duck
+type that drives gate evaluators over scalars (`ScalarOps`), device arrays
+(`ArrayOps`) and verifier openings (`ExtScalarOps`) — here over circuit
+variables, so *verifier formulas run inside a circuit*. This is the engine of
+the recursive verifier: `gate.evaluate(CircuitExtOps(cs), row_of_opening_vars,
+dst)` re-emits the inner circuit's quotient constraints as gadget constraints.
+
+Base ops lower to FMA gates; extension ops are pairs (c0, c1) over
+F_p[w]/(w^2 - 7) with schoolbook mul fused into 4 FMA gates.
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import FmaGate, SelectionGate
+from ..field import gl
+
+NON_RESIDUE = 7
+
+
+class CircuitOps:
+    """Base-field ops over variable ids (bound to a CS)."""
+
+    def __init__(self, cs):
+        self.cs = cs
+
+    def zero(self):
+        return self.cs.zero_var()
+
+    def one(self):
+        return self.cs.one_var()
+
+    def constant(self, v: int):
+        return self.cs.allocate_constant(v % gl.P)
+
+    def add(self, a, b):
+        return FmaGate.fma(self.cs, self.one(), a, b, 1, 1)
+
+    def sub(self, a, b):
+        return FmaGate.fma(self.cs, self.one(), b, a, gl.P - 1, 1)
+
+    def mul(self, a, b):
+        return FmaGate.fma(self.cs, a, b, self.zero(), 1, 0)
+
+    def neg(self, a):
+        return FmaGate.fma(self.cs, self.one(), a, self.zero(), gl.P - 1, 0)
+
+    def double(self, a):
+        return FmaGate.fma(self.cs, self.one(), a, self.zero(), 2, 0)
+
+    # -- extras beyond the evaluator contract -------------------------------
+
+    def fma(self, a, b, c, ca=1, cc=1):
+        """ca·a·b + cc·c."""
+        return FmaGate.fma(self.cs, a, b, c, ca, cc)
+
+    def mul_by_constant(self, a, k: int):
+        return FmaGate.fma(self.cs, self.one(), a, self.zero(), k, 0)
+
+    def enforce_equal(self, a, b):
+        """a − b = 0 as one FMA row with an existing-variable rhs."""
+        FmaGate.enforce_fma(self.cs, self.one(), a, b, a, 0, 1)
+
+    def enforce_zero(self, a):
+        FmaGate.enforce_fma(self.cs, self.one(), a, self.zero(), a, 0, 0)
+
+    def inv(self, a):
+        """Witness inverse with a·a_inv = 1 enforced (nonzero input only —
+        verifier-side denominators)."""
+        cs = self.cs
+        iv = cs.alloc_variable_without_value()
+        cs.set_values_with_dependencies([a], [iv], lambda v: [gl.inv(v[0])])
+        FmaGate.enforce_fma(cs, a, iv, self.zero(), self.one(), 1, 0)
+        return iv
+
+    def select(self, flag, a, b):
+        return SelectionGate.select(self.cs, flag, a, b)
+
+
+class CircuitExtOps:
+    """GF(p^2) ops over (c0_var, c1_var) pairs; w^2 = 7."""
+
+    def __init__(self, cs):
+        self.cs = cs
+        self.base = CircuitOps(cs)
+
+    def zero(self):
+        z = self.cs.zero_var()
+        return (z, z)
+
+    def one(self):
+        return (self.cs.one_var(), self.cs.zero_var())
+
+    def constant(self, v: int):
+        return (self.cs.allocate_constant(v % gl.P), self.cs.zero_var())
+
+    def from_base_constants(self, c0: int, c1: int):
+        return (
+            self.cs.allocate_constant(c0 % gl.P),
+            self.cs.allocate_constant(c1 % gl.P),
+        )
+
+    def from_base_var(self, v):
+        return (v, self.cs.zero_var())
+
+    def add(self, a, b):
+        return (self.base.add(a[0], b[0]), self.base.add(a[1], b[1]))
+
+    def sub(self, a, b):
+        return (self.base.sub(a[0], b[0]), self.base.sub(a[1], b[1]))
+
+    def neg(self, a):
+        return (self.base.neg(a[0]), self.base.neg(a[1]))
+
+    def double(self, a):
+        return (self.base.double(a[0]), self.base.double(a[1]))
+
+    def mul(self, a, b):
+        """(a0 + a1 w)(b0 + b1 w): c0 = a0 b0 + 7 a1 b1, c1 = a0 b1 + a1 b0
+        — four FMA gates."""
+        t = self.base.fma(a[0], b[0], self.cs.zero_var(), 1, 0)
+        c0 = self.base.fma(a[1], b[1], t, NON_RESIDUE, 1)
+        u = self.base.fma(a[0], b[1], self.cs.zero_var(), 1, 0)
+        c1 = self.base.fma(a[1], b[0], u, 1, 1)
+        return (c0, c1)
+
+    def mul_by_base(self, a, b_var):
+        return (self.base.mul(a[0], b_var), self.base.mul(a[1], b_var))
+
+    def mul_by_base_constant(self, a, k: int):
+        return (
+            self.base.mul_by_constant(a[0], k),
+            self.base.mul_by_constant(a[1], k),
+        )
+
+    def inv(self, a):
+        """Witness ext inverse with a·a_inv = 1 enforced."""
+        cs = self.cs
+        iv0 = cs.alloc_variable_without_value()
+        iv1 = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            from ..field import extension as ext_f
+
+            return list(ext_f.inv_s((vals[0], vals[1])))
+
+        cs.set_values_with_dependencies([a[0], a[1]], [iv0, iv1], resolve)
+        prod = self.mul(a, (iv0, iv1))
+        self.base.enforce_equal(prod[0], cs.one_var())
+        self.base.enforce_zero(prod[1])
+        return (iv0, iv1)
+
+    def pow(self, a, e: int):
+        """Square-and-multiply with a circuit mul per step."""
+        assert e >= 0
+        if e == 0:
+            return self.one()
+        result = None
+        cur = a
+        while e:
+            if e & 1:
+                result = cur if result is None else self.mul(result, cur)
+            e >>= 1
+            if e:
+                cur = self.mul(cur, cur)
+        return result
+
+    def enforce_equal(self, a, b):
+        self.base.enforce_equal(a[0], b[0])
+        self.base.enforce_equal(a[1], b[1])
+
+    def select(self, flag, a, b):
+        return (
+            self.base.select(flag, a[0], b[0]),
+            self.base.select(flag, a[1], b[1]),
+        )
+
+    def get_value(self, a):
+        return (self.cs.get_value(a[0]), self.cs.get_value(a[1]))

@@ -1,0 +1,400 @@
+"""Non-native field arithmetic over 16-bit limbs.
+
+Counterpart of `/root/reference/src/gadgets/non_native_field/` (traits +
+`implementations/implementation_u16.rs`, 2,093 LoC): arithmetic in a foreign
+prime field (secp256k1 base/scalar, BN254, …) encoded as vectors of 16-bit
+limb variables over Goldilocks.
+
+Design (same math, different factoring than the reference's lazy-bound
+tracker): every operation enforces one integer congruence
+`EXPR = q·m + r` through a limb-column carry chain —
+
+  Σ_k (expr_k − (q·m)_k − r_k)·2^{16k} = 0
+
+checked column by column with bounded signed carries in offset form
+(carry + 2^B range-checked to B+2 bits). Every column constraint stays far
+below the Goldilocks modulus, so field equality IS integer equality; the
+telescoped chain with a zero final carry proves the congruence exactly.
+Limb products for `mul` are FMA variables; (q·m)_k terms are constant-coeff
+linear combinations (m is a circuit constant), so reduction gates carry them.
+
+Results always come out as fresh 16-bit-checked limbs with value < 2^(16·N)
+(not necessarily < m — canonicity is enforced on demand via
+`enforce_reduced`, mirroring the reference's lazy normalization).
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import FmaGate, ReductionGate
+from ..cs.gates.u32 import UIntXAddGate
+from ..field import gl
+from .boolean import Boolean
+from .chunk_utils import decompose_and_check, range_check_chunks_batched
+from .num import Num
+
+LIMB_BITS = 16
+LIMB = 1 << LIMB_BITS
+CARRY_OFFSET_BITS = 22  # |carry| < 2^22 given <= 33 products of 2^32 per col
+CARRY_CHECK_BITS = 24  # offset carries range-checked to this many bits
+
+
+class NNFParams:
+    def __init__(self, modulus: int, name: str = "nnf"):
+        self.modulus = modulus
+        self.name = name
+        self.num_limbs = (modulus.bit_length() + LIMB_BITS - 1) // LIMB_BITS
+        self.m_limbs = [
+            (modulus >> (LIMB_BITS * i)) & (LIMB - 1)
+            for i in range(self.num_limbs)
+        ]
+
+
+def _limbs_of(value: int, n: int):
+    return [(value >> (LIMB_BITS * i)) & (LIMB - 1) for i in range(n)]
+
+
+class _LinAcc:
+    """Accumulates Σ coeff·var + const into a chained reduction scan."""
+
+    def __init__(self, cs):
+        self.cs = cs
+        self.items: list = []
+        self.const = 0
+
+    def add_term(self, var, coeff: int):
+        c = coeff % gl.P
+        if c:
+            self.items.append((var, c))
+
+    def add_const(self, v: int):
+        self.const = (self.const + v) % gl.P
+
+    def build(self):
+        cs = self.cs
+        items = list(self.items)
+        if self.const:
+            items.append((cs.one_var(), self.const))
+        if not items:
+            return cs.zero_var()
+        acc = None
+        while items:
+            chunk, items = items[:3], items[3:]
+            vars4 = [v for v, _ in chunk]
+            cf = [c for _, c in chunk]
+            if acc is not None:
+                vars4 = [acc] + vars4
+                cf = [1] + cf
+            while len(vars4) < 4:
+                vars4.append(cs.zero_var())
+                cf.append(0)
+            acc = ReductionGate.reduce(cs, vars4, cf)
+        return acc
+
+    def enforce_zero(self):
+        v = self.build()
+        FmaGate.enforce_fma(
+            self.cs, self.cs.one_var(), v, self.cs.zero_var(), self.cs.zero_var(), 0, 1
+        )
+
+
+def _enforce_congruence(cs, columns, q_limbs, r_limbs, params):
+    """Enforce Σ columns_k·2^{16k} = q·m + Σ r_k·2^{16k} as integers.
+
+    columns: list over k of `_LinAcc`-style term lists
+    [(var, coeff), ...] plus a constant, all guaranteed nonneg-bounded well
+    below p per column. q_limbs / r_limbs are 16-bit-checked variables.
+    """
+    n = params.num_limbs
+    num_cols = max(len(columns), len(q_limbs) + n - 1, n)
+    offset = 1 << CARRY_OFFSET_BITS
+    prev_s = None  # offset carry variable entering the column
+    for k in range(num_cols):
+        acc = _LinAcc(cs)
+        if k < len(columns):
+            terms, const = columns[k]
+            for var, coeff in terms:
+                acc.add_term(var, coeff)
+            acc.add_const(const)
+        # - (q·m)_k
+        for i, qv in enumerate(q_limbs):
+            j = k - i
+            if 0 <= j < n and params.m_limbs[j]:
+                acc.add_term(qv, -params.m_limbs[j])
+        # - r_k
+        if k < len(r_limbs):
+            acc.add_term(r_limbs[k], -1)
+        # + carry_in  (carry = s_prev - 2^B)
+        if prev_s is not None:
+            acc.add_term(prev_s, 1)
+            acc.add_const(-offset)
+        if k == num_cols - 1:
+            # final carry must be zero
+            acc.enforce_zero()
+            break
+        # - 2^16·carry_out, carry_out = s - 2^B
+        s = cs.alloc_variable_without_value()
+
+        def resolve(vals, terms=list(acc.items), const=acc.const):
+            total = const % gl.P
+            for (var, coeff), v in zip(terms, vals):
+                total = (total + coeff * v) % gl.P
+            # interpret as signed small integer around 0
+            if total > gl.P // 2:
+                total -= gl.P
+            assert total % LIMB == 0, "congruence column not divisible"
+            return [(total // LIMB + offset) % gl.P]
+
+        cs.set_values_with_dependencies(
+            [v for v, _ in acc.items], [s], resolve
+        )
+        decompose_and_check(cs, s, CARRY_CHECK_BITS)
+        acc.add_term(s, -(LIMB))
+        acc.add_const(LIMB * offset)
+        acc.enforce_zero()
+        prev_s = s
+
+
+class NonNativeField:
+    """A foreign-field element as 16-bit limb variables."""
+
+    __slots__ = ("limbs", "params")
+
+    def __init__(self, limbs, params: NNFParams):
+        assert len(limbs) == params.num_limbs
+        self.limbs = list(limbs)
+        self.params = params
+
+    # -- allocation ---------------------------------------------------------
+
+    @classmethod
+    def allocate_checked(cls, cs, value: int, params: NNFParams):
+        assert 0 <= value < params.modulus
+        limbs = []
+        for lv in _limbs_of(value, params.num_limbs):
+            v = cs.alloc_variable_with_value(lv)
+            decompose_and_check(cs, v, LIMB_BITS)
+            limbs.append(v)
+        return cls(limbs, params)
+
+    @classmethod
+    def allocated_constant(cls, cs, value: int, params: NNFParams):
+        assert 0 <= value < (1 << (LIMB_BITS * params.num_limbs))
+        return cls(
+            [cs.allocate_constant(lv) for lv in _limbs_of(value, params.num_limbs)],
+            params,
+        )
+
+    @classmethod
+    def zero(cls, cs, params: NNFParams):
+        return cls.allocated_constant(cs, 0, params)
+
+    @classmethod
+    def one(cls, cs, params: NNFParams):
+        return cls.allocated_constant(cs, 1, params)
+
+    def get_value(self, cs) -> int:
+        out = 0
+        for i, v in enumerate(self.limbs):
+            out |= cs.get_value(v) << (LIMB_BITS * i)
+        return out % self.params.modulus
+
+    def get_raw_value(self, cs) -> int:
+        out = 0
+        for i, v in enumerate(self.limbs):
+            out |= cs.get_value(v) << (LIMB_BITS * i)
+        return out
+
+    # -- internals ----------------------------------------------------------
+
+    def _alloc_result(self, cs, value: int, num_q: int, q_value: int):
+        """Fresh 16-bit-checked r limbs for `value` and q limbs for q_value."""
+        p = self.params
+        assert 0 <= q_value < (1 << (LIMB_BITS * num_q)), "quotient overflow"
+        r_limbs = []
+        for lv in _limbs_of(value, p.num_limbs):
+            v = cs.alloc_variable_with_value(lv)
+            decompose_and_check(cs, v, LIMB_BITS)
+            r_limbs.append(v)
+        q_limbs = []
+        for lv in _limbs_of(q_value, num_q):
+            v = cs.alloc_variable_with_value(lv)
+            decompose_and_check(cs, v, LIMB_BITS)
+            q_limbs.append(v)
+        return r_limbs, q_limbs
+
+    # -- ring ops -----------------------------------------------------------
+
+    def add(self, cs, other: "NonNativeField") -> "NonNativeField":
+        p = self.params
+        a = self.get_raw_value(cs)
+        b = other.get_raw_value(cs)
+        total = a + b
+        q, r = divmod(total, p.modulus)
+        r_limbs, q_limbs = self._alloc_result(cs, r, 2, q)
+        columns = [
+            ([(self.limbs[k], 1), (other.limbs[k], 1)], 0)
+            for k in range(p.num_limbs)
+        ]
+        _enforce_congruence(cs, columns, q_limbs, r_limbs, p)
+        return NonNativeField(r_limbs, p)
+
+    def sub(self, cs, other: "NonNativeField") -> "NonNativeField":
+        """a - b ≡ a + (K·m)_digits - b with K·m pre-redistributed so every
+        column stays nonnegative."""
+        p = self.params
+        n = p.num_limbs
+        # digits of 2·m with d_k >= 2^16 - 1 for k < top (host-side borrow)
+        K = 2
+        d = _limbs_of(K * p.modulus, n + 1)
+        for k in range(n):
+            if d[k] < LIMB - 1:
+                d[k] += LIMB
+                d[k + 1] -= 1
+        assert all(x >= 0 for x in d)
+        a = self.get_raw_value(cs)
+        b = other.get_raw_value(cs)
+        total = a + K * p.modulus - b
+        q, r = divmod(total, p.modulus)
+        r_limbs, q_limbs = self._alloc_result(cs, r, 2, q)
+        columns = []
+        for k in range(n + 1):
+            terms = []
+            if k < n:
+                terms.append((self.limbs[k], 1))
+                terms.append((other.limbs[k], -1 + gl.P))
+            columns.append((terms, d[k]))
+        _enforce_congruence(cs, columns, q_limbs, r_limbs, p)
+        return NonNativeField(r_limbs, p)
+
+    def negated(self, cs) -> "NonNativeField":
+        return NonNativeField.zero(cs, self.params).sub(cs, self)
+
+    def mul(self, cs, other: "NonNativeField") -> "NonNativeField":
+        p = self.params
+        n = p.num_limbs
+        a = self.get_raw_value(cs)
+        b = other.get_raw_value(cs)
+        q, r = divmod(a * b, p.modulus)
+        r_limbs, q_limbs = self._alloc_result(cs, r, n + 1, q)
+        # product variables per (i, j), grouped into columns
+        columns = [([], 0) for _ in range(2 * n - 1)]
+        for i in range(n):
+            for j in range(n):
+                pv = FmaGate.fma(
+                    cs, self.limbs[i], other.limbs[j], cs.zero_var(), 1, 0
+                )
+                columns[i + j][0].append((pv, 1))
+        _enforce_congruence(cs, columns, q_limbs, r_limbs, p)
+        return NonNativeField(r_limbs, p)
+
+    def square(self, cs) -> "NonNativeField":
+        return self.mul(cs, self)
+
+    def inv(self, cs) -> "NonNativeField":
+        """Witness inverse with self·inv ≡ 1 (mod m) enforced. Input must be
+        nonzero mod m."""
+        p = self.params
+        n = p.num_limbs
+        a = self.get_raw_value(cs) % p.modulus
+        iv = pow(a, -1, p.modulus)
+        iv_limbs = []
+        for lv in _limbs_of(iv, n):
+            v = cs.alloc_variable_with_value(lv)
+            decompose_and_check(cs, v, LIMB_BITS)
+            iv_limbs.append(v)
+        inv_el = NonNativeField(iv_limbs, p)
+        q = (self.get_raw_value(cs) * iv - 1) // p.modulus
+        q_limbs = []
+        for lv in _limbs_of(q, n + 1):
+            v = cs.alloc_variable_with_value(lv)
+            decompose_and_check(cs, v, LIMB_BITS)
+            q_limbs.append(v)
+        one_limbs = [cs.one_var()] + [cs.zero_var()] * (n - 1)
+        columns = [([], 0) for _ in range(2 * n - 1)]
+        for i in range(n):
+            for j in range(n):
+                pv = FmaGate.fma(
+                    cs, self.limbs[i], iv_limbs[j], cs.zero_var(), 1, 0
+                )
+                columns[i + j][0].append((pv, 1))
+        _enforce_congruence(cs, columns, q_limbs, one_limbs, p)
+        return inv_el
+
+    def div(self, cs, other: "NonNativeField") -> "NonNativeField":
+        return self.mul(cs, other.inv(cs))
+
+    # -- canonicity / predicates -------------------------------------------
+
+    def enforce_reduced(self, cs):
+        """Enforce raw value < m: (m-1) - self has no borrow — a u16 sub
+        chain whose final borrow is pinned to zero."""
+        p = self.params
+        n = p.num_limbs
+        m1 = _limbs_of(p.modulus - 1, n)
+        raw = self.get_raw_value(cs)
+        assert raw < p.modulus, "witness not reduced"
+        d = p.modulus - 1 - raw
+        gate = UIntXAddGate(16)
+        carry = cs.zero_var()
+        for k in range(n):
+            dv = cs.alloc_variable_with_value((d >> (16 * k)) & (LIMB - 1))
+            decompose_and_check(cs, dv, LIMB_BITS)
+            cout = (
+                cs.alloc_variable_with_value(
+                    1
+                    if (raw & ((1 << (16 * (k + 1))) - 1))
+                    + (d & ((1 << (16 * (k + 1))) - 1))
+                    >= (1 << (16 * (k + 1)))
+                    else 0
+                )
+                if k + 1 < n
+                else cs.zero_var()
+            )
+            m1_var = cs.allocate_constant(m1[k])
+            cs.place_gate(
+                gate, [self.limbs[k], dv, carry, m1_var, cout], ()
+            )
+            carry = cout
+
+    @staticmethod
+    def equals(cs, a: "NonNativeField", b: "NonNativeField") -> Boolean:
+        """Canonical equality: both sides reduced, then limbwise compare."""
+        a.enforce_reduced(cs)
+        b.enforce_reduced(cs)
+        flags = [
+            Num(la).equals(cs, Num(lb))
+            for la, lb in zip(a.limbs, b.limbs)
+        ]
+        return Boolean.multi_and(cs, flags)
+
+    def is_zero(self, cs) -> Boolean:
+        self.enforce_reduced(cs)
+        total = Num.linear_combination(
+            cs, [Num(v) for v in self.limbs], [1] * self.params.num_limbs
+        )
+        return total.is_zero(cs)
+
+    @staticmethod
+    def select(cs, flag: Boolean, a: "NonNativeField", b: "NonNativeField"):
+        assert a.params is b.params
+        from ..cs.gates.simple import SelectionGate
+
+        limbs = [
+            SelectionGate.select(cs, flag.var, la, lb)
+            for la, lb in zip(a.limbs, b.limbs)
+        ]
+        return NonNativeField(limbs, a.params)
+
+
+# Common parameter presets (reference uses secp256k1 for ECRecover circuits)
+SECP256K1_BASE = NNFParams(
+    (1 << 256) - (1 << 32) - 977, "secp256k1_base"
+)
+SECP256K1_SCALAR = NNFParams(
+    0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141,
+    "secp256k1_scalar",
+)
+BN254_BASE = NNFParams(
+    21888242871839275222246405745257275088696311157297823662689037894645226208583,
+    "bn254_base",
+)

@@ -123,26 +123,7 @@ class Num:
         cs.set_values_with_dependencies([self.var], bits, resolve)
         for b in bits:
             BooleanConstraintGate.enforce(cs, b)
-        # recomposition via reduction chain
-        acc = None
-        shift = 0
-        rem = list(bits)
-        while rem:
-            chunk, rem = rem[:3], rem[3:]
-            vars4 = []
-            cf = []
-            if acc is not None:
-                vars4.append(acc)
-                cf.append(1)
-            for b in chunk:
-                vars4.append(b)
-                cf.append(1 << shift)
-                shift += 1
-            while len(vars4) < 4:
-                vars4.append(cs.zero_var())
-                cf.append(0)
-            if rem:
-                acc = ReductionGate.reduce(cs, vars4, cf)
-            else:
-                ReductionGate.enforce_reduce(cs, vars4, cf, self.var)
+        from .chunk_utils import enforce_chunk_recomposition
+
+        enforce_chunk_recomposition(cs, bits, self.var, bits_per_chunk=1)
         return [Boolean(b) for b in bits]

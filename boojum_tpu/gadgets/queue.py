@@ -1,0 +1,125 @@
+"""Commitment-chained circuit queues.
+
+Counterpart of `/root/reference/src/gadgets/queue/` (CircuitQueue
+`mod.rs:29`, full_state_queue.rs, 1,210 LoC): a FIFO whose contents are
+committed by hash chaining — `push` folds the element encoding into the tail
+commitment, `pop_front` folds the (witness-provided) element into the head
+commitment, and `enforce_consistency` ties the ends together so the popped
+sequence must equal the pushed sequence. Length tracking is a range-checked
+counter; underflow is impossible because the length after a pop is
+re-range-checked.
+
+`CircuitQueue` carries a capacity-sized (4-element) commitment;
+`FullStateCircuitQueue` carries the whole width-12 sponge state as the
+commitment (cheaper chaining: one permutation per op, no squeeze)."""
+
+from __future__ import annotations
+
+from collections import deque
+
+from ..cs.gates.simple import FmaGate
+from ..field import gl
+from .boolean import Boolean
+from .chunk_utils import decompose_and_check
+from .num import Num
+from .poseidon2_rf import SW, circuit_hash_leaf, circuit_permutation
+
+T_COMMIT = 4
+
+
+class CircuitQueue:
+    """FIFO with 4-element head/tail commitments (reference mod.rs:29)."""
+
+    def __init__(self, cs, element_width: int):
+        zero = cs.zero_var()
+        self.cs = cs
+        self.element_width = element_width
+        self.head = [zero] * T_COMMIT
+        self.tail = [zero] * T_COMMIT
+        self.length = Num(zero)
+        self._witness: deque = deque()
+
+    def push(self, cs, element_vars):
+        assert len(element_vars) == self.element_width
+        self.tail = circuit_hash_leaf(cs, list(element_vars) + self.tail)
+        self.length = self.length.add_constant(cs, 1)
+        self._witness.append(
+            [cs.get_value(v) for v in element_vars]
+        )
+
+    def pop_front(self, cs):
+        """Allocate the next element from witness, fold it into the head
+        chain, decrement+re-range-check the length (underflow guard)."""
+        values = self._witness.popleft()
+        el = [cs.alloc_variable_with_value(v) for v in values]
+        self.head = circuit_hash_leaf(cs, el + self.head)
+        self.length = self.length.add_constant(cs, gl.P - 1)
+        decompose_and_check(cs, self.length.var, 32)
+        return el
+
+    def is_empty(self, cs) -> Boolean:
+        return self.length.is_zero(cs)
+
+    def enforce_consistency(self, cs):
+        """If the queue is (claimed) fully drained, head must equal tail —
+        i.e. the popped sequence is exactly the pushed sequence (reference
+        mod.rs:506)."""
+        empty = self.is_empty(cs)
+        for h, t in zip(self.head, self.tail):
+            diff = FmaGate.fma(cs, cs.one_var(), t, h, gl.P - 1, 1)
+            FmaGate.enforce_fma(
+                cs, empty.var, diff, cs.zero_var(), cs.zero_var(), 1, 0
+            )
+
+    def enforce_trivial_head(self, cs):
+        zero = cs.zero_var()
+        for h in self.head:
+            FmaGate.enforce_fma(
+                cs, cs.one_var(), h, zero, zero, 1, 0
+            )
+
+
+class FullStateCircuitQueue:
+    """FIFO carrying the full width-12 state as commitment (reference
+    full_state_queue.rs): chaining is a single permutation with the element
+    encoding overwriting the rate."""
+
+    def __init__(self, cs, element_width: int):
+        assert element_width <= 8, "encoding must fit the sponge rate"
+        zero = cs.zero_var()
+        self.cs = cs
+        self.element_width = element_width
+        self.head = [zero] * SW
+        self.tail = [zero] * SW
+        self.length = Num(zero)
+        self._witness: deque = deque()
+
+    def _chain(self, cs, state, element_vars):
+        zero = cs.zero_var()
+        rate = list(element_vars) + [zero] * (8 - self.element_width)
+        return circuit_permutation(cs, rate + state[8:])
+
+    def push(self, cs, element_vars):
+        assert len(element_vars) == self.element_width
+        self.tail = self._chain(cs, self.tail, element_vars)
+        self.length = self.length.add_constant(cs, 1)
+        self._witness.append([cs.get_value(v) for v in element_vars])
+
+    def pop_front(self, cs):
+        values = self._witness.popleft()
+        el = [cs.alloc_variable_with_value(v) for v in values]
+        self.head = self._chain(cs, self.head, el)
+        self.length = self.length.add_constant(cs, gl.P - 1)
+        decompose_and_check(cs, self.length.var, 32)
+        return el
+
+    def is_empty(self, cs) -> Boolean:
+        return self.length.is_zero(cs)
+
+    def enforce_consistency(self, cs):
+        empty = self.is_empty(cs)
+        for h, t in zip(self.head, self.tail):
+            diff = FmaGate.fma(cs, cs.one_var(), t, h, gl.P - 1, 1)
+            FmaGate.enforce_fma(
+                cs, empty.var, diff, cs.zero_var(), cs.zero_var(), 1, 0
+            )

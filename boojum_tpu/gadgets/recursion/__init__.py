@@ -1,0 +1,15 @@
+"""Recursive verification layer (reference `/root/reference/src/gadgets/recursion/`):
+a Boojum verifier expressed as a circuit, so one proof attests to another.
+"""
+
+from .transcript import CircuitTranscript, CircuitBitSource
+from .allocated_proof import AllocatedProof, AllocatedVerificationKey
+from .verifier import recursive_verify
+
+__all__ = [
+    "CircuitTranscript",
+    "CircuitBitSource",
+    "AllocatedProof",
+    "AllocatedVerificationKey",
+    "recursive_verify",
+]

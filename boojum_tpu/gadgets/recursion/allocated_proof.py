@@ -1,0 +1,66 @@
+"""Proof and verification key as circuit witnesses.
+
+Counterpart of `/root/reference/src/gadgets/recursion/allocated_proof.rs` and
+`allocated_vk.rs`: every field element of a host `Proof` /`VerificationKey`
+becomes an allocated variable; the fixed parameters (geometry, FRI schedule,
+gate set) stay host-side — they shape the circuit, they are not witness data.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+
+
+def _alloc(cs, v: int) -> int:
+    return cs.alloc_variable_with_value(int(v) % gl.P)
+
+
+def _alloc_cap(cs, cap):
+    return [[_alloc(cs, x) for x in digest] for digest in cap]
+
+
+def _alloc_pairs(cs, pairs):
+    return [(_alloc(cs, c0), _alloc(cs, c1)) for (c0, c1) in pairs]
+
+
+class AllocatedOracleQuery:
+    def __init__(self, cs, query):
+        self.leaf_values = [_alloc(cs, v) for v in query.leaf_values]
+        self.path = [[_alloc(cs, x) for x in sib] for sib in query.path]
+
+
+class AllocatedSingleRoundQueries:
+    def __init__(self, cs, q):
+        self.witness = AllocatedOracleQuery(cs, q.witness)
+        self.stage2 = AllocatedOracleQuery(cs, q.stage2)
+        self.quotient = AllocatedOracleQuery(cs, q.quotient)
+        self.setup = AllocatedOracleQuery(cs, q.setup)
+        self.fri = [AllocatedOracleQuery(cs, f) for f in q.fri]
+
+
+class AllocatedProof:
+    """Witness allocation of a host Proof (reference allocated_proof.rs)."""
+
+    def __init__(self, cs, proof):
+        self.public_inputs = [_alloc(cs, v) for v in proof.public_inputs]
+        self.witness_cap = _alloc_cap(cs, proof.witness_cap)
+        self.stage2_cap = _alloc_cap(cs, proof.stage2_cap)
+        self.quotient_cap = _alloc_cap(cs, proof.quotient_cap)
+        self.values_at_z = _alloc_pairs(cs, proof.values_at_z)
+        self.values_at_z_omega = _alloc_pairs(cs, proof.values_at_z_omega)
+        self.values_at_0 = _alloc_pairs(cs, proof.values_at_0)
+        self.fri_caps = [_alloc_cap(cs, c) for c in proof.fri_caps]
+        self.final_fri_monomials = _alloc_pairs(cs, proof.final_fri_monomials)
+        self.queries = [
+            AllocatedSingleRoundQueries(cs, q) for q in proof.queries
+        ]
+        self.pow_challenge = _alloc(cs, proof.pow_challenge)
+
+
+class AllocatedVerificationKey:
+    """Witness allocation of the VK's setup cap; the structural fields stay
+    host-side on the vk object (reference allocated_vk.rs)."""
+
+    def __init__(self, cs, vk):
+        self.setup_merkle_cap = _alloc_cap(cs, vk.setup_merkle_cap)
+        self.vk = vk

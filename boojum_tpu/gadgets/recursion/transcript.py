@@ -1,0 +1,126 @@
+"""In-circuit Fiat–Shamir transcript.
+
+Counterpart of `/root/reference/src/gadgets/recursion/recursive_transcript.rs`:
+the same sponge algorithm as the host `Poseidon2Transcript`
+(`boojum_tpu.transcript`) — overwrite absorption, rescue-prime padding with a
+trailing 1 — but over circuit variables via the flattened Poseidon2 gate, so
+the recursion circuit recomputes exactly the challenges the prover drew.
+
+Query-index bits mirror the host `BitSource`: each challenge is decomposed
+into 64 boolean bits with a canonicity constraint (the high 32 bits all-ones
+forces the low 32 bits to zero — Goldilocks p = 2^64 - 2^32 + 1 makes that
+the only non-canonical encoding), and only the low `64 - max_needed` bits of
+each challenge are consumed.
+"""
+
+from __future__ import annotations
+
+from ...cs.gates.simple import BooleanConstraintGate, FmaGate, ReductionGate
+from ...field import gl
+from ..poseidon2_rf import circuit_permutation
+
+
+class CircuitTranscript:
+    def __init__(self, cs):
+        self.cs = cs
+        zero = cs.zero_var()
+        self.state = [zero] * 12
+        self.buffer: list = []
+        self.available: list = []
+
+    def witness_field_elements(self, variables):
+        self.buffer.extend(variables)
+
+    def witness_merkle_tree_cap(self, cap_digest_vars):
+        for digest in cap_digest_vars:
+            self.witness_field_elements(list(digest))
+
+    def get_challenge(self):
+        if not self.buffer:
+            if self.available:
+                return self.available.pop(0)
+            self.state = circuit_permutation(self.cs, self.state)
+            self.available = list(self.state[:8])
+            return self.available.pop(0)
+        to_absorb = self.buffer + [self.cs.one_var()]
+        self.buffer = []
+        zero = self.cs.zero_var()
+        while len(to_absorb) % 8 != 0:
+            to_absorb.append(zero)
+        for i in range(0, len(to_absorb), 8):
+            self.state = circuit_permutation(
+                self.cs, to_absorb[i : i + 8] + self.state[8:]
+            )
+        self.available = list(self.state[:8])
+        return self.available.pop(0)
+
+    def get_multiple_challenges(self, n: int):
+        return [self.get_challenge() for _ in range(n)]
+
+    def get_ext_challenge(self):
+        return (self.get_challenge(), self.get_challenge())
+
+
+def decompose_challenge_canonical(cs, c_var):
+    """64 LE boolean bit variables of a challenge with the canonical-repr
+    constraint. Returns the bit list."""
+    bits = cs.alloc_multiple_variables_without_values(64)
+
+    def resolve(vals):
+        x = vals[0]
+        return [(x >> i) & 1 for i in range(64)]
+
+    cs.set_values_with_dependencies([c_var], bits, resolve)
+    for b in bits:
+        BooleanConstraintGate.enforce(cs, b)
+    # recomposition: sum b_i 2^i = c (mod p)
+    from ..chunk_utils import enforce_chunk_recomposition
+
+    enforce_chunk_recomposition(cs, bits, c_var, bits_per_chunk=1)
+    # canonicity: AND(high 32 bits) * (low 32 bits recomposed) == 0
+    high_and = bits[32]
+    for b in bits[33:]:
+        high_and = FmaGate.fma(cs, high_and, b, cs.zero_var(), 1, 0)
+    low_acc = None
+    shift = 0
+    rem = list(bits[:32])
+    while rem:
+        chunk, rem = rem[:3], rem[3:]
+        vars4, cf = [], []
+        if low_acc is not None:
+            vars4.append(low_acc)
+            cf.append(1)
+        for b in chunk:
+            vars4.append(b)
+            cf.append(1 << shift)
+            shift += 1
+        while len(vars4) < 4:
+            vars4.append(cs.zero_var())
+            cf.append(0)
+        low_acc = ReductionGate.reduce(cs, vars4, cf)
+    FmaGate.enforce_fma(cs, high_and, low_acc, cs.zero_var(), cs.zero_var(), 1, 0)
+    return bits
+
+
+class CircuitBitSource:
+    """In-circuit face of the host BitSource (`transcript.py:56`): boolean
+    bit variables drawn from canonical challenge decompositions."""
+
+    def __init__(self, cs, max_needed_bits: int):
+        assert 0 < max_needed_bits < 64
+        self.cs = cs
+        self.bits: list = []
+        self.max_needed = max_needed_bits
+
+    def get_bits(self, transcript: CircuitTranscript, num_bits: int):
+        while len(self.bits) < num_bits:
+            c = transcript.get_challenge()
+            all_bits = decompose_challenge_canonical(self.cs, c)
+            usable = 64 - self.max_needed
+            self.bits.extend(all_bits[:usable])
+        out, self.bits = self.bits[:num_bits], self.bits[num_bits:]
+        return out
+
+    def get_index_bits(self, transcript: CircuitTranscript, num_bits: int):
+        """LE boolean bit vars of one query index."""
+        return self.get_bits(transcript, num_bits)

@@ -48,16 +48,14 @@ MASK4 = 0xF
 def register_sha256_tables(cs):
     """Add the five SHA tables if not present; returns their ids."""
     ids = {}
-    for build in (trixor4_table, ch4_table, maj4_table):
-        t = build()
-        if t.name not in cs._table_by_name:
-            cs.add_lookup_table(t)
-        ids[t.name] = cs.get_table_id(t.name)
-    for s in (1, 2):
-        t = split4bit_table(s)
-        if t.name not in cs._table_by_name:
-            cs.add_lookup_table(t)
-        ids[t.name] = cs.get_table_id(t.name)
+    for name, build in (
+        ("trixor4", trixor4_table),
+        ("ch4", ch4_table),
+        ("maj4", maj4_table),
+        ("split4bit_at1", lambda: split4bit_table(1)),
+        ("split4bit_at2", lambda: split4bit_table(2)),
+    ):
+        ids[name] = cs.ensure_table(name, build)
     return ids
 
 

@@ -38,6 +38,19 @@ def maj4_table() -> LookupTable:
     return _tri_table("maj4", lambda a, b, c: (a & b) ^ (a & c) ^ (b & c))
 
 
+def byte_split_table(split_at: int) -> LookupTable:
+    """x in [0,256) -> (low = x mod 2^split_at, high = x >> split_at)
+    (reference tables/byte_split.rs). One table per split point; used by the
+    bit-rotation gadgets in Keccak-256 and Blake2s."""
+    assert 0 < split_at < 8
+    x = np.arange(256, dtype=np.uint64)
+    low = x & np.uint64((1 << split_at) - 1)
+    high = x >> np.uint64(split_at)
+    return LookupTable(
+        f"byte_split_at{split_at}", 1, 2, np.stack([x, low, high], axis=1)
+    )
+
+
 def split4bit_table(split_at: int) -> LookupTable:
     """x in [0,16) -> (low = x & mask, high = x >> split_at, reversed =
     low·2^(4-split_at) | high) (reference chunk4bits.rs

@@ -1,0 +1,234 @@
+"""Wide unsigned integers as u32-limb vectors: UInt160 / UInt256 / UInt512.
+
+Counterpart of `/root/reference/src/gadgets/u160,u256,u512/` (3,249 LoC with
+u8/u16/u32): checked arithmetic with carry chains over the U32 gates,
+widening multiplication (schoolbook over u32 limbs through the U32 FMA gate),
+byte (de)compositions, masking and equality. Limb range correctness comes
+from the 4-bit-chunk lookups (`decompose_and_check`), carry relations from
+the dedicated u32 gates — the same split the reference uses.
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import ReductionGate
+from ..cs.gates.u32 import U32AddGate, U32FmaGate, U32SubGate
+from .boolean import Boolean
+from .chunk_utils import decompose_and_check
+from .num import Num
+from .uint import UInt8, UInt32
+
+
+class UIntWide:
+    """Base: little-endian vector of NUM_LIMBS UInt32 limbs."""
+
+    NUM_LIMBS = 0
+    __slots__ = ("limbs",)
+
+    def __init__(self, limbs):
+        assert len(limbs) == self.NUM_LIMBS
+        self.limbs = list(limbs)
+
+    @property
+    def BITS(self):
+        return 32 * self.NUM_LIMBS
+
+    # -- allocation ---------------------------------------------------------
+
+    @classmethod
+    def allocate_checked(cls, cs, value: int):
+        assert 0 <= value < (1 << (32 * cls.NUM_LIMBS))
+        limbs = [
+            UInt32.allocate_checked(cs, (value >> (32 * i)) & 0xFFFFFFFF)
+            for i in range(cls.NUM_LIMBS)
+        ]
+        return cls(limbs)
+
+    @classmethod
+    def allocated_constant(cls, cs, value: int):
+        assert 0 <= value < (1 << (32 * cls.NUM_LIMBS))
+        limbs = [
+            UInt32.allocated_constant(cs, (value >> (32 * i)) & 0xFFFFFFFF)
+            for i in range(cls.NUM_LIMBS)
+        ]
+        return cls(limbs)
+
+    @classmethod
+    def zero(cls, cs):
+        return cls.allocated_constant(cs, 0)
+
+    def get_value(self, cs) -> int:
+        out = 0
+        for i, limb in enumerate(self.limbs):
+            out |= limb.get_value(cs) << (32 * i)
+        return out
+
+    # -- arithmetic ---------------------------------------------------------
+
+    def overflowing_add(self, cs, other):
+        """(self + other mod 2^BITS, overflow Boolean) — u32 carry chain
+        (reference u256/mod.rs:166)."""
+        assert type(self) is type(other)
+        carry = cs.zero_var()
+        out = []
+        for a, b in zip(self.limbs, other.limbs):
+            c, carry = U32AddGate.add(cs, a.var, b.var, carry)
+            decompose_and_check(cs, c, 32)
+            out.append(UInt32(c))
+        return type(self)(out), Boolean(carry)
+
+    def overflowing_sub(self, cs, other):
+        """(self - other mod 2^BITS, borrow Boolean) (reference :188)."""
+        assert type(self) is type(other)
+        borrow = cs.zero_var()
+        out = []
+        for a, b in zip(self.limbs, other.limbs):
+            c, borrow = U32SubGate.sub(cs, a.var, b.var, borrow)
+            decompose_and_check(cs, c, 32)
+            out.append(UInt32(c))
+        return type(self)(out), Boolean(borrow)
+
+    # -- predicates / control ----------------------------------------------
+
+    def is_zero(self, cs) -> Boolean:
+        """Σ limbs == 0 (limbs are nonneg and the sum stays far below p)."""
+        total = Num.linear_combination(
+            cs, [limb.into_num() for limb in self.limbs],
+            [1] * self.NUM_LIMBS,
+        )
+        return total.is_zero(cs)
+
+    @staticmethod
+    def equals(cs, a, b) -> Boolean:
+        assert type(a) is type(b)
+        diff, borrow = a.overflowing_sub(cs, b)
+        return diff.is_zero(cs).and_(cs, borrow.negate(cs))
+
+    def mask(self, cs, flag: Boolean):
+        """flag ? self : 0 (reference :252)."""
+        zero = cs.zero_var()
+        out = [
+            UInt32(Num(limb.var).mask(cs, flag).var) for limb in self.limbs
+        ]
+        return type(self)(out)
+
+    def mask_negated(self, cs, flag: Boolean):
+        return self.mask(cs, flag.negate(cs))
+
+    @staticmethod
+    def select(cs, flag: Boolean, a, b):
+        assert type(a) is type(b)
+        out = [
+            UInt32.select(cs, flag, la, lb)
+            for la, lb in zip(a.limbs, b.limbs)
+        ]
+        return type(a)(out)
+
+    # -- byte casts ---------------------------------------------------------
+
+    @classmethod
+    def from_le_bytes(cls, cs, bytes_le):
+        assert len(bytes_le) == 4 * cls.NUM_LIMBS
+        limbs = []
+        for i in range(cls.NUM_LIMBS):
+            b = bytes_le[4 * i : 4 * i + 4]
+            v = ReductionGate.reduce(
+                cs, [x.var for x in b], [1, 1 << 8, 1 << 16, 1 << 24]
+            )
+            limbs.append(UInt32(v))
+        return cls(limbs)
+
+    @classmethod
+    def from_be_bytes(cls, cs, bytes_be):
+        return cls.from_le_bytes(cs, list(reversed(bytes_be)))
+
+    def to_le_bytes(self, cs):
+        out = []
+        for limb in self.limbs:
+            out.extend(limb.to_le_bytes(cs))
+        return out
+
+    def to_be_bytes(self, cs):
+        return list(reversed(self.to_le_bytes(cs)))
+
+    # -- bit structure ------------------------------------------------------
+
+    def div2(self, cs):
+        """(self >> 1, low bit Boolean): x = 2·y + b via the u32 add gate
+        applied limbwise, top-down (reference u256/mod.rs:333)."""
+        n = self.NUM_LIMBS
+        ys = cs.alloc_multiple_variables_without_values(n)
+        bit = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            x = sum(v << (32 * i) for i, v in enumerate(vals))
+            y = x >> 1
+            return [(y >> (32 * i)) & 0xFFFFFFFF for i in range(n)] + [x & 1]
+
+        cs.set_values_with_dependencies(
+            [limb.var for limb in self.limbs], list(ys) + [bit], resolve
+        )
+        Boolean.from_variable_checked(cs, bit)
+        # carry chain: 2·y_i + c_i = x_i + 2^32·c_{i+1}; c_0 = bit
+        carry = bit
+        for i in range(n):
+            # place the u32 add gate over existing vars: y+y+cin = x + 2^32·cout
+            cout = (
+                cs.alloc_variable_without_value()
+                if i + 1 < n
+                else cs.zero_var()
+            )
+            if i + 1 < n:
+                cs.set_values_with_dependencies(
+                    [ys[i], carry],
+                    [cout],
+                    lambda v: [(2 * v[0] + v[1]) >> 32],
+                )
+            cs.place_gate(
+                U32AddGate.instance(),
+                [ys[i], ys[i], carry, self.limbs[i].var, cout],
+                (),
+            )
+            decompose_and_check(cs, ys[i], 32)
+            carry = cout
+        return type(self)([UInt32(y) for y in ys]), Boolean(bit)
+
+    def is_odd(self, cs) -> Boolean:
+        return self.div2(cs)[1]
+
+
+class UInt160(UIntWide):
+    NUM_LIMBS = 5
+
+
+class UInt256(UIntWide):
+    NUM_LIMBS = 8
+
+    def widening_mul(self, cs, other: "UInt256") -> "UInt512":
+        """Full 512-bit product via schoolbook u32 limbs (reference
+        u256/mod.rs:218): row i accumulates a_i·b_j into the running result
+        limbs through the u32 FMA gate's (low, high) split."""
+        n = self.NUM_LIMBS
+        res = [cs.zero_var()] * (2 * n)
+        for i in range(n):
+            carry = cs.zero_var()
+            for j in range(n):
+                low, high = U32FmaGate.fma(
+                    cs, self.limbs[i].var, other.limbs[j].var,
+                    res[i + j], carry,
+                )
+                decompose_and_check(cs, low, 32)
+                decompose_and_check(cs, high, 32)
+                res[i + j] = low
+                carry = high
+            res[i + n] = carry
+        return UInt512([UInt32(v) for v in res])
+
+
+class UInt512(UIntWide):
+    NUM_LIMBS = 16
+
+    def to_low(self) -> UInt256:
+        return UInt256(self.limbs[:8])
+
+    def to_high(self) -> UInt256:
+        return UInt256(self.limbs[8:])

@@ -44,6 +44,19 @@ class MerkleTreeWithCap:
         self.layers = layers
         self._cap_host = [tuple(int(x) for x in row) for row in np.asarray(layers[-1])]
 
+    @classmethod
+    def from_layers(cls, layers, cap_size: int) -> "MerkleTreeWithCap":
+        """Rebuild a tree from precomputed digest layers (setup fast
+        deserialization — no rehashing, reference fast_serialization.rs)."""
+        tree = cls.__new__(cls)
+        tree.cap_size = cap_size
+        tree.num_leaves = int(layers[0].shape[0])
+        tree.layers = list(layers)
+        tree._cap_host = [
+            tuple(int(x) for x in row) for row in np.asarray(layers[-1])
+        ]
+        return tree
+
     def get_cap(self):
         return list(self._cap_host)
 

@@ -3,3 +3,9 @@ from .setup import SetupData, VerificationKey, generate_setup
 from .prover import prove
 from .verifier import verify
 from .proof import Proof
+from .convenience import (
+    prove_one_shot,
+    prepare_setup_and_vk,
+    prove_from_precomputations,
+    verify_circuit,
+)

@@ -1,0 +1,40 @@
+"""One-shot convenience drivers.
+
+Counterpart of `/root/reference/src/cs/implementations/convenience.rs`:
+`prove_one_shot` (:34), `prepare_base_setup_with_precomputations_and_vk`
+(:82), `prove_from_precomputations` (:119), `verify_circuit` (:198).
+"""
+
+from __future__ import annotations
+
+from .config import ProofConfig
+from .prover import prove
+from .setup import SetupData, generate_setup
+from .verifier import verify
+
+
+def prove_one_shot(cs, config: ProofConfig | None = None):
+    """Synthesized CS -> (assembly, setup, proof). The CS must have been
+    built with witness evaluation on."""
+    config = config or ProofConfig()
+    assembly = cs.into_assembly()
+    setup = generate_setup(assembly, config)
+    proof = prove(assembly, setup, config)
+    return assembly, setup, proof
+
+
+def prepare_setup_and_vk(cs, config: ProofConfig | None = None):
+    """(assembly, setup) for repeated proving (reference :82)."""
+    config = config or ProofConfig()
+    assembly = cs.into_assembly()
+    return assembly, generate_setup(assembly, config)
+
+
+def prove_from_precomputations(assembly, setup: SetupData, config: ProofConfig):
+    """Re-prove with existing setup (reference :119)."""
+    return prove(assembly, setup, config)
+
+
+def verify_circuit(vk, proof, gates) -> bool:
+    """Reference :198."""
+    return verify(vk, proof, gates)

@@ -1,10 +1,14 @@
-"""Proof-of-work grinding (reference `PoWRunner`, pow.rs:7).
+"""Proof-of-work grinding (reference `PoWRunner`, pow.rs:7,51,140).
 
-Algebraic Poseidon2 PoW: seed = 4 transcript challenges; find a u64 nonce
-such that hash(seed ‖ nonce)[0] has `pow_bits` low zero bits. The nonce is
-absorbed back into the transcript before query-index sampling so queries are
-grinding-bound. (The reference's Blake2s/Keccak256 byte-oriented runners are
-an alternative backend to add alongside.)
+Algebraic Poseidon2 PoW (recursion-friendly: the recursive verifier replays
+it with one flattened-gate sponge call): seed = 4 transcript challenges; find
+a u64 nonce such that hash(seed ‖ nonce)[0] has `pow_bits` low zero bits. The
+nonce is absorbed back into the transcript before query-index sampling so
+queries are grinding-bound.
+
+Byte-oriented Blake2s / Keccak256 runners mirror the reference's alternative
+backends: seed = 4 challenges as LE bytes, digest's first LE u64 must have
+`pow_bits` low zero bits.
 """
 
 from ..hashes.poseidon2 import Poseidon2SpongeHost
@@ -35,3 +39,67 @@ def pow_verify(transcript, pow_bits: int, nonce: int) -> bool:
         return False
     transcript.witness_field_elements([nonce])
     return True
+
+
+def _byte_pow_grind(transcript, pow_bits: int, hasher) -> int:
+    if pow_bits == 0:
+        return 0
+    assert pow_bits <= 32, "unreasonable pow difficulty"
+    seed = b"".join(
+        c.to_bytes(8, "little")
+        for c in transcript.get_multiple_challenges(4)
+    )
+    mask = (1 << pow_bits) - 1
+    nonce = 0
+    while True:
+        h = hasher(seed + nonce.to_bytes(8, "little"))
+        if int.from_bytes(h[:8], "little") & mask == 0:
+            break
+        nonce += 1
+    transcript.witness_field_elements([nonce])
+    return nonce
+
+
+def _byte_pow_verify(transcript, pow_bits: int, nonce: int, hasher) -> bool:
+    if pow_bits == 0:
+        return True
+    seed = b"".join(
+        c.to_bytes(8, "little")
+        for c in transcript.get_multiple_challenges(4)
+    )
+    mask = (1 << pow_bits) - 1
+    h = hasher(seed + int(nonce).to_bytes(8, "little"))
+    if int.from_bytes(h[:8], "little") & mask != 0:
+        return False
+    transcript.witness_field_elements([nonce])
+    return True
+
+
+def blake2s_pow_grind(transcript, pow_bits: int) -> int:
+    """Blake2s nonce search (reference pow.rs:51)."""
+    import hashlib
+
+    return _byte_pow_grind(
+        transcript, pow_bits, lambda d: hashlib.blake2s(d).digest()
+    )
+
+
+def blake2s_pow_verify(transcript, pow_bits: int, nonce: int) -> bool:
+    import hashlib
+
+    return _byte_pow_verify(
+        transcript, pow_bits, nonce, lambda d: hashlib.blake2s(d).digest()
+    )
+
+
+def keccak256_pow_grind(transcript, pow_bits: int) -> int:
+    """Keccak-256 nonce search (reference pow.rs:140)."""
+    from ..hashes.keccak_host import keccak256
+
+    return _byte_pow_grind(transcript, pow_bits, keccak256)
+
+
+def keccak256_pow_verify(transcript, pow_bits: int, nonce: int) -> bool:
+    from ..hashes.keccak_host import keccak256
+
+    return _byte_pow_verify(transcript, pow_bits, nonce, keccak256)

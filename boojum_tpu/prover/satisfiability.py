@@ -6,6 +6,8 @@ ScalarOps — a three-way cross-check of the field-like contract.
 
 from __future__ import annotations
 
+import numpy as np
+
 from ..cs.field_like import ScalarOps
 from ..cs.gates.base import RowView, TermsCollector
 
@@ -49,46 +51,57 @@ def check_if_satisfied(assembly, verbose: bool = False) -> bool:
 def _check_lookups(assembly, verbose: bool) -> bool:
     """Every placed lookup tuple is a table row and the multiplicity column
     counts exactly the placed tuples (reference satisfiability_test.rs lookup
-    spot checks)."""
+    spot checks). Rows are deduplicated first (np.unique over stacked
+    [table-id; lookup columns]) so the padding-dominated tail of large traces
+    costs one check, not n."""
     lp = assembly.lookup_params
     R, w = lp.num_repetitions, lp.width
-    n = assembly.trace_len
     vals = assembly.lookup_cols_values
     tid_col = assembly.lookup_table_id_col
+    stacked = np.vstack([np.asarray(tid_col, dtype=np.uint64)[None, :], vals])
+    uniq, ucounts = np.unique(stacked, axis=1, return_counts=True)
     counts = {}
-    for row in range(n):
-        tid = int(tid_col[row])
+    for u in range(uniq.shape[1]):
+        tid = int(uniq[0, u])
+        times = int(ucounts[u])
         if tid == 0:
             if verbose:
-                print(f"LOOKUP: row {row} has no table id")
+                print("LOOKUP: row(s) with no table id")
             return False
         table = assembly.lookup_tables[tid - 1]
+        col = uniq[1:, u]
         for s in range(R):
-            tup = tuple(int(vals[s * w + j, row]) for j in range(table.width))
+            tup = tuple(int(col[s * w + j]) for j in range(table.width))
             try:
                 ridx = table.row_index(tup)
             except (KeyError, AssertionError):
                 if verbose:
                     print(
-                        f"LOOKUP UNSATISFIED: row {row} sub-arg {s} tuple "
+                        f"LOOKUP UNSATISFIED: sub-arg {s} tuple "
                         f"{tup} not in table {table.name}"
                     )
                 return False
             for j in range(table.width, w):
-                if int(vals[s * w + j, row]) != 0:
+                if int(col[s * w + j]) != 0:
                     if verbose:
-                        print(f"LOOKUP: row {row} sub-arg {s} pad not zero")
+                        print(f"LOOKUP: sub-arg {s} pad not zero")
                     return False
             key = (tid, ridx)
-            counts[key] = counts.get(key, 0) + 1
+            counts[key] = counts.get(key, 0) + times
+    # compare the FULL multiplicity vector (zeros included): a spurious
+    # nonzero multiplicity on a never-looked-up row breaks the B(0) = ΣA_i(0)
+    # sum check in the real argument and must fail here too
+    expected = np.zeros(assembly.trace_len, dtype=np.uint64)
     for (tid, ridx), cnt in counts.items():
-        gidx = assembly.table_offsets[tid] + ridx
-        if int(assembly.multiplicities[gidx]) != cnt:
-            if verbose:
-                print(
-                    f"LOOKUP UNSATISFIED: multiplicity of table {tid} row "
-                    f"{ridx}: column says {int(assembly.multiplicities[gidx])},"
-                    f" trace has {cnt}"
-                )
-            return False
+        expected[assembly.table_offsets[tid] + ridx] = cnt
+    bad = np.nonzero(expected != np.asarray(assembly.multiplicities))[0]
+    if bad.size:
+        if verbose:
+            g = int(bad[0])
+            print(
+                f"LOOKUP UNSATISFIED: multiplicity at stacked row {g}: "
+                f"column says {int(assembly.multiplicities[g])}, trace has "
+                f"{int(expected[g])}"
+            )
+        return False
     return True

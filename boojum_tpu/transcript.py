@@ -53,6 +53,79 @@ class Poseidon2Transcript:
         return (c0, c1)
 
 
+class _ByteTranscript:
+    """Byte-oriented transcript base (reference Blake2sTranscript /
+    Keccak256Transcript, transcript.rs:155,264): field elements are absorbed
+    as 8-byte LE words; on each challenge request the pending buffer is
+    folded into a running 32-byte seed, then challenges are squeezed as
+    `hash(seed ‖ counter_le4)` blocks, each 8-byte LE word reduced mod p."""
+
+    def __init__(self):
+        self.seed = b"\x00" * 32
+        self.buffer = bytearray()
+        self.counter = 0
+        self.available = []
+
+    def _hash(self, data: bytes) -> bytes:
+        raise NotImplementedError
+
+    def witness_field_elements(self, els):
+        for e in els:
+            self.buffer += (int(e) % gl.P).to_bytes(8, "little")
+
+    def witness_merkle_tree_cap(self, cap):
+        for digest in cap:
+            self.witness_field_elements(digest)
+
+    def get_challenge(self) -> int:
+        if self.buffer:
+            self.seed = self._hash(self.seed + bytes(self.buffer))
+            self.buffer = bytearray()
+            self.counter = 0
+            self.available = []
+        if not self.available:
+            block = self._hash(
+                self.seed + self.counter.to_bytes(4, "little")
+            )
+            self.counter += 1
+            self.available = [
+                int.from_bytes(block[i : i + 8], "little") % gl.P
+                for i in range(0, 32, 8)
+            ]
+        return self.available.pop(0)
+
+    def get_multiple_challenges(self, n: int):
+        return [self.get_challenge() for _ in range(n)]
+
+    def get_ext_challenge(self):
+        return (self.get_challenge(), self.get_challenge())
+
+
+class Blake2sTranscript(_ByteTranscript):
+    def _hash(self, data: bytes) -> bytes:
+        import hashlib
+
+        return hashlib.blake2s(data).digest()
+
+
+class Keccak256Transcript(_ByteTranscript):
+    def _hash(self, data: bytes) -> bytes:
+        from .hashes.keccak_host import keccak256
+
+        return keccak256(data)
+
+
+TRANSCRIPTS = {
+    "poseidon2": Poseidon2Transcript,
+    "blake2s": Blake2sTranscript,
+    "keccak256": Keccak256Transcript,
+}
+
+
+def make_transcript(kind: str = "poseidon2"):
+    return TRANSCRIPTS[kind]()
+
+
 class BitSource:
     """Uniform query-index bits drawn from transcript challenges.
 

@@ -106,3 +106,17 @@ def test_bad_multiplicities_fail_satisfiability():
     asm.multiplicities = asm.multiplicities.copy()
     asm.multiplicities[0] += 1
     assert not check_if_satisfied(asm, verbose=False)
+
+
+def test_spurious_multiplicity_on_unused_row_fails():
+    """A nonzero multiplicity on a table row no lookup touches must fail
+    (it breaks the B(0) = sum A_i(0) sum check in the real argument)."""
+    import numpy as np
+
+    cs, _, _ = build_circuit(num_lookups=6)
+    asm = cs.into_assembly()
+    asm.multiplicities = asm.multiplicities.copy()
+    untouched = np.nonzero(np.asarray(asm.multiplicities) == 0)[0]
+    assert untouched.size > 0
+    asm.multiplicities[int(untouched[0])] = 5
+    assert not check_if_satisfied(asm, verbose=False)

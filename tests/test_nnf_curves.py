@@ -1,0 +1,146 @@
+"""Non-native field + curve gadget tests: parity vs python bigint / host EC
+math + satisfiability (reference test model: non_native_field and curves
+tests)."""
+
+import random
+
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.cs.types import CSGeometry, LookupParameters
+from boojum_tpu.gadgets.curves import SWProjectivePoint
+from boojum_tpu.gadgets.non_native_field import (
+    NNFParams,
+    NonNativeField,
+    SECP256K1_BASE,
+)
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+GEOM = CSGeometry(
+    num_columns_under_copy_permutation=60,
+    num_witness_columns=0,
+    num_constant_columns=8,
+    max_allowed_constraint_degree=7,
+)
+
+LOOKUP = LookupParameters(width=4, num_repetitions=8)
+
+P = SECP256K1_BASE.modulus
+
+
+def make_cs(size=1 << 15):
+    return ConstraintSystem(GEOM, size, lookup_params=LOOKUP)
+
+
+def test_nnf_ring_ops_parity():
+    rng = random.Random(17)
+    cs = make_cs()
+    a, b = rng.randrange(P), rng.randrange(P)
+    na = NonNativeField.allocate_checked(cs, a, SECP256K1_BASE)
+    nb = NonNativeField.allocate_checked(cs, b, SECP256K1_BASE)
+    assert na.add(cs, nb).get_value(cs) == (a + b) % P
+    assert na.sub(cs, nb).get_value(cs) == (a - b) % P
+    assert nb.sub(cs, na).get_value(cs) == (b - a) % P
+    assert na.mul(cs, nb).get_value(cs) == (a * b) % P
+    assert na.square(cs).get_value(cs) == (a * a) % P
+    assert na.negated(cs).get_value(cs) == (-a) % P
+    iv = na.inv(cs)
+    assert iv.get_value(cs) == pow(a, -1, P)
+    assert na.div(cs, nb).get_value(cs) == (a * pow(b, -1, P)) % P
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_nnf_predicates():
+    cs = make_cs()
+    a = 12345678901234567890
+    na = NonNativeField.allocate_checked(cs, a, SECP256K1_BASE)
+    nb = NonNativeField.allocate_checked(cs, a, SECP256K1_BASE)
+    nc = NonNativeField.allocate_checked(cs, a + 1, SECP256K1_BASE)
+    assert NonNativeField.equals(cs, na, nb).get_value(cs)
+    assert not NonNativeField.equals(cs, na, nc).get_value(cs)
+    assert NonNativeField.zero(cs, SECP256K1_BASE).is_zero(cs).get_value(cs)
+    assert not na.is_zero(cs).get_value(cs)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_nnf_congruence_tamper_rejected():
+    cs = make_cs()
+    a, b = 3, 5
+    na = NonNativeField.allocate_checked(cs, a, SECP256K1_BASE)
+    nb = NonNativeField.allocate_checked(cs, b, SECP256K1_BASE)
+    prod = na.mul(cs, nb)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm)
+    # corrupt the first product-result limb in the trace
+    place = prod.limbs[0]
+    import numpy as np
+
+    rows = np.argwhere(asm.copy_placement == place)
+    assert len(rows) > 0
+    col, row = rows[0]
+    asm.copy_cols_values[col, row] = (
+        int(asm.copy_cols_values[col, row]) + 1
+    ) % (2**64 - 2**32 + 1)
+    assert not check_if_satisfied(asm)
+
+
+# -- curve tests -------------------------------------------------------------
+
+GX = 0x79BE667EF9DCBBAC55A06295CE870B07029BFCDB2DCE28D959F2815B16F81798
+GY = 0x483ADA7726A3C4655DA4FBFC0E1108A8FD17B448A68554199C47D08FFB10D4B8
+
+
+def _ec_add(p1, p2):
+    """Affine secp256k1 addition (host reference)."""
+    if p1 is None:
+        return p2
+    if p2 is None:
+        return p1
+    x1, y1 = p1
+    x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P == 0:
+        return None
+    if p1 == p2:
+        lam = (3 * x1 * x1) * pow(2 * y1, -1, P) % P
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P) % P
+    x3 = (lam * lam - x1 - x2) % P
+    y3 = (lam * (x1 - x3) - y1) % P
+    return (x3, y3)
+
+
+def test_curve_double_add_parity():
+    cs = make_cs(1 << 16)
+    gx = NonNativeField.allocate_checked(cs, GX, SECP256K1_BASE)
+    gy = NonNativeField.allocate_checked(cs, GY, SECP256K1_BASE)
+    pt = SWProjectivePoint.from_xy_unchecked(cs, gx, gy, 7)
+    pt.enforce_on_curve(cs)
+    two_g = pt.double(cs)
+    three_g = two_g.add_mixed(cs, gx, gy)
+    (x2, y2), inf2 = two_g.convert_to_affine_or_default(cs, 0, 0)
+    (x3, y3), inf3 = three_g.convert_to_affine_or_default(cs, 0, 0)
+    e2 = _ec_add((GX, GY), (GX, GY))
+    e3 = _ec_add(e2, (GX, GY))
+    assert not inf2.get_value(cs) and not inf3.get_value(cs)
+    assert (x2.get_value(cs), y2.get_value(cs)) == e2
+    assert (x3.get_value(cs), y3.get_value(cs)) == e3
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_curve_identity_handling():
+    cs = make_cs(1 << 16)
+    zero_pt = SWProjectivePoint.zero(cs, SECP256K1_BASE, 7)
+    gx = NonNativeField.allocate_checked(cs, GX, SECP256K1_BASE)
+    gy = NonNativeField.allocate_checked(cs, GY, SECP256K1_BASE)
+    g = zero_pt.add_mixed(cs, gx, gy)
+    (x, y), inf = g.convert_to_affine_or_default(cs, 0, 0)
+    assert not inf.get_value(cs)
+    assert (x.get_value(cs), y.get_value(cs)) == (GX, GY)
+    # G - G = identity
+    g2 = SWProjectivePoint.from_xy_unchecked(cs, gx, gy, 7)
+    diff = g2.sub_mixed(cs, gx, gy)
+    _, inf_d = diff.convert_to_affine_or_default(cs, 0, 0)
+    assert inf_d.get_value(cs)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)

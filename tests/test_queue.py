@@ -1,0 +1,69 @@
+"""Circuit queue tests (reference test model: queue gadget tests —
+push/pop roundtrip, consistency enforcement, tamper rejection)."""
+
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.cs.types import CSGeometry, LookupParameters
+from boojum_tpu.field import gl
+from boojum_tpu.gadgets.queue import CircuitQueue, FullStateCircuitQueue
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+GEOM = CSGeometry(
+    num_columns_under_copy_permutation=130,
+    num_witness_columns=0,
+    num_constant_columns=8,
+    max_allowed_constraint_degree=7,
+)
+
+LOOKUP = LookupParameters(width=4, num_repetitions=8)
+
+
+def make_cs():
+    return ConstraintSystem(GEOM, 1 << 14, lookup_params=LOOKUP)
+
+
+def test_queue_roundtrip():
+    cs = make_cs()
+    q = CircuitQueue(cs, element_width=3)
+    items = [[1, 2, 3], [4, 5, 6], [7, 8, 9]]
+    for it in items:
+        q.push(cs, [cs.alloc_variable_with_value(v) for v in it])
+    assert not q.is_empty(cs).get_value(cs)
+    popped = []
+    while q._witness:
+        el = q.pop_front(cs)
+        popped.append([cs.get_value(v) for v in el])
+    assert popped == items
+    assert q.is_empty(cs).get_value(cs)
+    q.enforce_consistency(cs)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_full_state_queue_roundtrip():
+    cs = make_cs()
+    q = FullStateCircuitQueue(cs, element_width=8)
+    items = [[i * 8 + j for j in range(8)] for i in range(3)]
+    for it in items:
+        q.push(cs, [cs.alloc_variable_with_value(v) for v in it])
+    popped = []
+    while q._witness:
+        el = q.pop_front(cs)
+        popped.append([cs.get_value(v) for v in el])
+    assert popped == items
+    q.enforce_consistency(cs)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+
+
+def test_queue_tamper_rejected():
+    """Popping a different sequence than was pushed must break the final
+    head==tail consistency constraint."""
+    cs = make_cs()
+    q = CircuitQueue(cs, element_width=2)
+    q.push(cs, [cs.alloc_variable_with_value(v) for v in (10, 20)])
+    # tamper the stored witness before popping
+    q._witness[0] = [10, 21]
+    q.pop_front(cs)
+    q.enforce_consistency(cs)
+    asm = cs.into_assembly()
+    assert not check_if_satisfied(asm)

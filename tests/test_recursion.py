@@ -1,0 +1,56 @@
+"""Recursive verification tests (reference test model:
+recursive_verifier.rs:2213 — prove a circuit, synthesize the verifier circuit
+over the proof, check satisfiability)."""
+
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.cs.types import CSGeometry
+from boojum_tpu.field import gl
+from boojum_tpu.gadgets.recursion import recursive_verify
+from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+from boojum_tpu.prover.proof import Proof
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+from test_e2e import GEOM as INNER_GEOM, build_fibonacci_circuit
+
+RECURSION_GEOM = CSGeometry(
+    num_columns_under_copy_permutation=130,
+    num_witness_columns=0,
+    num_constant_columns=8,
+    max_allowed_constraint_degree=7,
+)
+
+INNER_CONFIG = ProofConfig(
+    fri_lde_factor=8,
+    merkle_tree_cap_size=4,
+    num_queries=8,
+    pow_bits=0,
+    fri_final_degree=4,
+)
+
+
+def _prove_inner():
+    cs, _ = build_fibonacci_circuit(steps=20)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, INNER_CONFIG)
+    proof = prove(asm, setup, INNER_CONFIG)
+    assert verify(setup.vk, proof, asm.gates)
+    return setup.vk, proof, asm.gates
+
+
+def test_recursive_verifier_satisfiable():
+    vk, proof, gates = _prove_inner()
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    pi_vars, _cap_vars = recursive_verify(outer, vk, proof, gates)
+    assert [outer.get_value(v) for v in pi_vars] == list(proof.public_inputs)
+    outer_asm = outer.into_assembly()
+    assert check_if_satisfied(outer_asm, verbose=True)
+
+
+def test_recursive_verifier_rejects_bad_proof():
+    vk, proof, gates = _prove_inner()
+    bad = Proof.from_json(proof.to_json())
+    bad.public_inputs[0] = (bad.public_inputs[0] + 1) % gl.P
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    recursive_verify(outer, vk, bad, gates)
+    outer_asm = outer.into_assembly()
+    assert not check_if_satisfied(outer_asm)

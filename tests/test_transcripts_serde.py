@@ -1,0 +1,102 @@
+"""Byte transcripts, PoW runners, serialization, convenience drivers
+(reference test model: transcript.rs / pow.rs / fast_serialization.rs)."""
+
+import os
+
+from boojum_tpu.field import gl
+from boojum_tpu.prover.pow import (
+    blake2s_pow_grind,
+    blake2s_pow_verify,
+    keccak256_pow_grind,
+    keccak256_pow_verify,
+)
+from boojum_tpu.serialization import (
+    load_setup,
+    save_setup,
+    vk_from_json,
+    vk_to_json,
+)
+from boojum_tpu.transcript import (
+    Blake2sTranscript,
+    Keccak256Transcript,
+    make_transcript,
+)
+
+
+def test_byte_transcripts_deterministic_and_sensitive():
+    for kind in ("blake2s", "keccak256"):
+        t1 = make_transcript(kind)
+        t2 = make_transcript(kind)
+        t1.witness_field_elements([1, 2, 3])
+        t2.witness_field_elements([1, 2, 3])
+        c1 = t1.get_multiple_challenges(5)
+        c2 = t2.get_multiple_challenges(5)
+        assert c1 == c2
+        assert all(0 <= c < gl.P for c in c1)
+        t3 = make_transcript(kind)
+        t3.witness_field_elements([1, 2, 4])
+        assert t3.get_challenge() != c1[0]
+        # absorbing after squeezing reseeds
+        t1.witness_field_elements([9])
+        more = t1.get_challenge()
+        assert more != c1[0]
+
+
+def test_transcript_kinds_differ():
+    b = Blake2sTranscript()
+    k = Keccak256Transcript()
+    b.witness_field_elements([7])
+    k.witness_field_elements([7])
+    assert b.get_challenge() != k.get_challenge()
+
+
+def test_byte_pow_runners():
+    for grind, check in (
+        (blake2s_pow_grind, blake2s_pow_verify),
+        (keccak256_pow_grind, keccak256_pow_verify),
+    ):
+        t = Blake2sTranscript()
+        t.witness_field_elements([42])
+        nonce = grind(t, 8)
+        after_grind = t.get_challenge()
+        tv = Blake2sTranscript()
+        tv.witness_field_elements([42])
+        assert check(tv, 8, nonce)
+        assert tv.get_challenge() == after_grind
+        tb = Blake2sTranscript()
+        tb.witness_field_elements([42])
+        assert not check(tb, 8, nonce + 1)
+
+
+def test_vk_json_roundtrip_and_setup_serde(tmp_path):
+    from test_e2e import CONFIG, build_fibonacci_circuit
+    from boojum_tpu.prover import (
+        generate_setup,
+        prove,
+        prove_from_precomputations,
+        verify,
+    )
+
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    # vk json roundtrip
+    vk2 = vk_from_json(vk_to_json(setup.vk))
+    assert vk2.to_dict() == setup.vk.to_dict()
+    # setup fast-serialization roundtrip; prove with the LOADED setup and
+    # verify against the ORIGINAL vk
+    path = os.path.join(tmp_path, "setup.npz")
+    save_setup(path, setup)
+    setup2 = load_setup(path)
+    assert setup2.vk.to_dict() == setup.vk.to_dict()
+    proof = prove_from_precomputations(asm, setup2, CONFIG)
+    assert verify(setup.vk, proof, asm.gates)
+
+
+def test_prove_one_shot_driver():
+    from test_e2e import CONFIG, build_fibonacci_circuit
+    from boojum_tpu.prover import prove_one_shot, verify_circuit
+
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm, setup, proof = prove_one_shot(cs, CONFIG)
+    assert verify_circuit(setup.vk, proof, asm.gates)
