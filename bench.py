@@ -1,11 +1,21 @@
 """Headline benchmark. Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Current headline: full e2e proof wall-clock on the toy arithmetic circuit
-(until the SHA-256 gadget circuit lands, after which this switches to the
-reference bench geometry: 2^16 rows, 60 copy cols, lookups — BASELINE.md).
-vs_baseline is wall-clock speedup vs the most recent recorded run in
-BENCH_BASELINE.json if present, else 1.0.
+Headline circuit: the reference's SHA-256 bench (8 kB message through the
+lookup-table SHA-256 gadget; reference src/gadgets/sha256/mod.rs:269 and
+README "For curions in benchmarks": 60 copy columns, 8 width-4 lookup
+sub-arguments, LDE factor 8, cap 16; the reference trace is 2^16 rows — the
+2^17 passed to the CS below is a CAPACITY bound, pad_and_shrink rounds the
+actual trace to the smallest power of two that fits and the bench prints the
+realized trace length on stderr). The timed quantity is the proving
+wall-clock with warm compile caches (the reference's "Proving is done,
+taken ..." line measures the same region).
+
+Environment knobs:
+  BENCH_CIRCUIT = sha256 (default) | fma
+  BENCH_SHA_BYTES = message size (default 8192)
+  BENCH_LOG_N = fma-mode trace log2 size (default 10)
+  BENCH_REPS = timed repetitions (default 1)
 """
 
 import json
@@ -16,13 +26,30 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 
-def main():
-    import jax
-
-    from boojum_tpu.cs.types import CSGeometry
+def build_sha256(num_bytes: int):
     from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry, LookupParameters
+    from boojum_tpu.gadgets import allocate_u8_input, sha256
+
+    geom = CSGeometry(
+        num_columns_under_copy_permutation=60,
+        num_witness_columns=0,
+        num_constant_columns=8,
+        max_allowed_constraint_degree=7,
+    )
+    cs = ConstraintSystem(
+        geom, 1 << 17,
+        lookup_params=LookupParameters(width=4, num_repetitions=8),
+    )
+    data = bytes(i % 255 for i in range(num_bytes))
+    sha256(cs, allocate_u8_input(cs, data))
+    return cs
+
+
+def build_fma(log_n: int):
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry
     from boojum_tpu.cs.gates import FmaGate, PublicInputGate
-    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
 
     geom = CSGeometry(
         num_columns_under_copy_permutation=16,
@@ -30,6 +57,22 @@ def main():
         num_constant_columns=6,
         max_allowed_constraint_degree=4,
     )
+    cs = ConstraintSystem(geom, 1 << log_n)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geom)
+    steps = ((1 << log_n) - 8) * per_row
+    for _ in range(steps):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    return cs
+
+
+def main():
+    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+
+    circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
+    reps = int(os.environ.get("BENCH_REPS", "1"))
     config = ProofConfig(
         fri_lde_factor=8,
         merkle_tree_cap_size=16,
@@ -37,24 +80,23 @@ def main():
         pow_bits=0,
         fri_final_degree=16,
     )
-    log_n = int(os.environ.get("BENCH_LOG_N", "10"))
-    cs = ConstraintSystem(geom, 1 << log_n)
-    a = cs.alloc_variable_with_value(1)
-    b = cs.alloc_variable_with_value(2)
-    # fill ~full trace with FMA chains
-    per_row = FmaGate.instance().num_repetitions(geom)
-    steps = ((1 << log_n) - 8) * per_row
-    for _ in range(steps):
-        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
-    PublicInputGate.place(cs, b)
+    if circuit == "sha256":
+        num_bytes = int(os.environ.get("BENCH_SHA_BYTES", "8192"))
+        cs = build_sha256(num_bytes)
+        metric = f"sha256_{num_bytes}B_prove_wall"
+    else:
+        log_n = int(os.environ.get("BENCH_LOG_N", "10"))
+        cs = build_fma(log_n)
+        metric = f"fma_2^{log_n}_prove_wall"
+
     asm = cs.into_assembly()
+    print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
     setup = generate_setup(asm, config)
 
-    # warm-up (compile) then timed runs
+    # warm-up (compiles) then timed runs
     proof = prove(asm, setup, config)
     assert verify(setup.vk, proof, asm.gates)
     t0 = time.perf_counter()
-    reps = 1
     for _ in range(reps):
         proof = prove(asm, setup, config)
     wall = (time.perf_counter() - t0) / reps
@@ -64,12 +106,12 @@ def main():
     if os.path.exists(base_path):
         try:
             base = json.load(open(base_path))
-            if base.get("metric") == f"fma_2^{log_n}_prove_wall" and base.get("value"):
+            if base.get("metric") == metric and base.get("value"):
                 vs = base["value"] / wall
         except Exception:
             pass
     print(json.dumps({
-        "metric": f"fma_2^{log_n}_prove_wall",
+        "metric": metric,
         "value": round(wall, 4),
         "unit": "s",
         "vs_baseline": round(vs, 3),

@@ -9,9 +9,30 @@ batched/vmapped XLA, and multi-chip scaling shards trace columns over an ICI
 mesh with XLA collectives.
 """
 
+import os
+
 import jax
 
 # The whole framework computes over GF(2^64 - 2^32 + 1); we need 64-bit ints.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent compilation cache: the prover pipelines are large jitted graphs
+# keyed by (shape, geometry); caching them on disk means only the first-ever
+# run of a given circuit shape pays XLA compile time. Opt out with
+# BOOJUM_TPU_NO_COMPILE_CACHE=1 or by pre-setting jax_compilation_cache_dir.
+if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
+    try:
+        if not jax.config.jax_compilation_cache_dir:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get(
+                    "BOOJUM_TPU_COMPILE_CACHE",
+                    os.path.expanduser("~/.cache/boojum_tpu_xla"),
+                ),
+            )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
 
 __version__ = "0.1.0"

@@ -17,3 +17,5 @@ from .simple import (
     MatrixMultiplicationGate,
 )
 from .u32 import U32AddGate, U32SubGate, U32FmaGate, U32TriAddCarryAsChunkGate, UIntXAddGate
+from .ext_fma import ExtFmaGate
+from .poseidon2_flat import Poseidon2FlattenedGate

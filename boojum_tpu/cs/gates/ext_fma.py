@@ -1,0 +1,102 @@
+"""FMA gate over the quadratic extension.
+
+Counterpart of `/root/reference/src/cs/gates/fma_gate_in_extension_without_constant.rs`
+(`compute_fma_in_extension` :368, inversion constraint :427): the relation
+`c0·a·b + c1·c = d` over GF(p²) = GF(p)[w]/(w²−7), with a,b,c,d carried as
+(c0, c1) base-variable pairs and the coefficients as four per-row constants.
+Two quotient terms (the result's two coordinates), degree 3.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+from ...field import extension as ext_host
+from .base import Gate
+
+NON_RESIDUE = 7
+
+
+def _ext_mul_ops(ops, a, b):
+    """(a0 + a1·w)(b0 + b1·w) over base field-like ops."""
+    c0 = ops.add(
+        ops.mul(a[0], b[0]),
+        ops.mul(ops.constant(NON_RESIDUE), ops.mul(a[1], b[1])),
+    )
+    c1 = ops.add(ops.mul(a[0], b[1]), ops.mul(a[1], b[0]))
+    return (c0, c1)
+
+
+class ExtFmaGate(Gate):
+    name = "ext_fma"
+    principal_width = 8  # a0 a1 b0 b1 c0 c1 d0 d1
+    num_constants = 4  # coeff_ab (2), coeff_c (2)
+    num_terms = 2
+    max_degree = 3
+
+    def evaluate(self, ops, row, dst):
+        a = (row.v(0), row.v(1))
+        b = (row.v(2), row.v(3))
+        c = (row.v(4), row.v(5))
+        d = (row.v(6), row.v(7))
+        k0 = (row.c(0), row.c(1))
+        k1 = (row.c(2), row.c(3))
+        t = _ext_mul_ops(ops, _ext_mul_ops(ops, k0, a), b)
+        u = _ext_mul_ops(ops, k1, c)
+        dst.push(ops.sub(ops.add(t[0], u[0]), d[0]))
+        dst.push(ops.sub(ops.add(t[1], u[1]), d[1]))
+
+    @staticmethod
+    def fma(cs, a, b, c, coeff_ab=(1, 0), coeff_c=(1, 0)):
+        """Allocate and constrain d = coeff_ab·a·b + coeff_c·c; all operands
+        are (var, var) extension pairs, coefficients host (int, int) pairs."""
+        k0 = (coeff_ab[0] % gl.P, coeff_ab[1] % gl.P)
+        k1 = (coeff_c[0] % gl.P, coeff_c[1] % gl.P)
+        d0 = cs.alloc_variable_without_value()
+        d1 = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            av, bv, cv = (vals[0], vals[1]), (vals[2], vals[3]), (vals[4], vals[5])
+            t = ext_host.mul_s(ext_host.mul_s(k0, av), bv)
+            u = ext_host.mul_s(k1, cv)
+            r = ext_host.add_s(t, u)
+            return [r[0], r[1]]
+
+        cs.set_values_with_dependencies(
+            [a[0], a[1], b[0], b[1], c[0], c[1]], [d0, d1], resolve
+        )
+        cs.place_gate(
+            ExtFmaGate.instance(),
+            [a[0], a[1], b[0], b[1], c[0], c[1], d0, d1],
+            k0 + k1,
+        )
+        return (d0, d1)
+
+    @staticmethod
+    def inversion(cs, a):
+        """Witness ext inverse with a·a_inv = 1 enforced through this gate
+        (reference create_inversion_constraint)."""
+        iv0 = cs.alloc_variable_without_value()
+        iv1 = cs.alloc_variable_without_value()
+
+        def resolve(vals):
+            r = ext_host.inv_s((vals[0], vals[1]))
+            return [r[0], r[1]]
+
+        cs.set_values_with_dependencies([a[0], a[1]], [iv0, iv1], resolve)
+        one = cs.one_var()
+        zero = cs.zero_var()
+        # place: coeff_ab·a·inv + 0·c = (1, 0), with d pinned to constants
+        cs.place_gate(
+            ExtFmaGate.instance(),
+            [a[0], a[1], iv0, iv1, zero, zero, one, zero],
+            (1, 0, 0, 0),
+        )
+        return (iv0, iv1)
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst

@@ -160,8 +160,11 @@ class Poseidon2FlattenedGate(Gate):
             out, aux = _witness_trace(list(vals))
             return out + aux
 
+        from ...native import OP_POSEIDON2
+
         cs.set_values_with_dependencies(
-            list(input_vars), list(outs) + list(auxs), resolve
+            list(input_vars), list(outs) + list(auxs), resolve,
+            native=(OP_POSEIDON2, ()),
         )
         cs.place_gate(
             Poseidon2FlattenedGate.instance(),

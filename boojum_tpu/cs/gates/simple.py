@@ -39,7 +39,11 @@ class FmaGate(Gate):
             av, bv, cv = vals
             return [gl.add(gl.mul(ca, gl.mul(av, bv)), gl.mul(cc, cv))]
 
-        cs.set_values_with_dependencies([a, b, c], [d], resolve)
+        from ...native import OP_FMA
+
+        cs.set_values_with_dependencies(
+            [a, b, c], [d], resolve, native=(OP_FMA, (ca, cc))
+        )
         cs.place_gate(FmaGate.instance(), [a, b, c, d], (ca, cc))
         return d
 
@@ -73,16 +77,25 @@ class ConstantsAllocatorGate(Gate):
         dst.push(ops.sub(row.v(0), row.c(0)))
 
     def padding_instance(self, cs, constants=()):
+        from ...native import OP_CONST
+
         c = constants[0] if constants else 0
         v = cs.alloc_variable_without_value()
-        cs.set_values_with_dependencies([], [v], lambda _: [c])
+        cs.set_values_with_dependencies(
+            [], [v], lambda _: [c], native=(OP_CONST, (c,))
+        )
         return [v]
 
     @staticmethod
     def allocate_constant(cs, value: int):
+        from ...native import OP_CONST
+
         value = value % gl.P
         v = cs.alloc_variable_without_value()
-        cs.set_values_with_dependencies([], [v], lambda _, value=value: [value])
+        cs.set_values_with_dependencies(
+            [], [v], lambda _, value=value: [value],
+            native=(OP_CONST, (value,)),
+        )
         cs.place_gate(ConstantsAllocatorGate.instance(), [v], (value,))
         return v
 
@@ -207,7 +220,11 @@ class ReductionGate(Gate):
                 acc = gl.add(acc, gl.mul(v, c))
             return [acc]
 
-        cs.set_values_with_dependencies(list(vars4), [out], resolve)
+        from ...native import OP_REDUCTION
+
+        cs.set_values_with_dependencies(
+            list(vars4), [out], resolve, native=(OP_REDUCTION, tuple(cf))
+        )
         cs.place_gate(ReductionGate.instance(), list(vars4) + [out], tuple(cf))
         return out
 

@@ -38,7 +38,12 @@ class U32AddGate(Gate):
             s = vals[0] + vals[1] + vals[2]
             return [s & 0xFFFFFFFF, s >> 32]
 
-        cs.set_values_with_dependencies([a, b, carry_in], [c, cout], resolve)
+        from ...native import OP_U32_ADD
+
+        cs.set_values_with_dependencies(
+            [a, b, carry_in], [c, cout], resolve,
+            native=(OP_U32_ADD, (32,)),
+        )
         cs.place_gate(U32AddGate.instance(), [a, b, carry_in, c, cout], ())
         return c, cout
 
@@ -77,7 +82,12 @@ class U32SubGate(Gate):
                 return [d + SHIFT32, 1]
             return [d, 0]
 
-        cs.set_values_with_dependencies([a, b, borrow_in], [c, bout], resolve)
+        from ...native import OP_U32_SUB
+
+        cs.set_values_with_dependencies(
+            [a, b, borrow_in], [c, bout], resolve,
+            native=(OP_U32_SUB, ()),
+        )
         cs.place_gate(U32SubGate.instance(), [a, b, borrow_in, c, bout], ())
         return c, bout
 
@@ -145,7 +155,12 @@ class U32FmaGate(Gate):
                 s & 0xFFFFFFFF, s >> 32, part >> 32,
             ]
 
-        cs.set_values_with_dependencies([a, b, c, carry_in], list(outs), resolve)
+        from ...native import OP_U32_FMA
+
+        cs.set_values_with_dependencies(
+            [a, b, c, carry_in], list(outs), resolve,
+            native=(OP_U32_FMA, ()),
+        )
         cs.place_gate(
             U32FmaGate.instance(),
             [a, b, c, carry_in, a_lo, a_hi, b_lo, b_hi, low, high, k],
@@ -191,7 +206,11 @@ class U32TriAddCarryAsChunkGate(Gate):
             s = vals[0] + vals[1] + vals[2]
             return [s & 0xFFFFFFFF, s >> 32]
 
-        cs.set_values_with_dependencies([a, b, c], [low, high], resolve)
+        from ...native import OP_TRIADD
+
+        cs.set_values_with_dependencies(
+            [a, b, c], [low, high], resolve, native=(OP_TRIADD, ())
+        )
         cs.place_gate(U32TriAddCarryAsChunkGate.instance(), [a, b, c, low, high], ())
         return low, high
 
@@ -240,7 +259,12 @@ class ByteTriAddGate(Gate):
             s += sum(v << (8 * i) for i, v in enumerate(vals[8:12]))
             return [(s >> (8 * i)) & 0xFF for i in range(4)] + [s >> 32]
 
-        cs.set_values_with_dependencies(ins, list(outs) + [carry], resolve)
+        from ...native import OP_BYTE_TRIADD
+
+        cs.set_values_with_dependencies(
+            ins, list(outs) + [carry], resolve,
+            native=(OP_BYTE_TRIADD, ()),
+        )
         cs.place_gate(
             ByteTriAddGate.instance(), ins + list(outs) + [carry], ()
         )
@@ -287,6 +311,11 @@ class UIntXAddGate(Gate):
             s = vals[0] + vals[1] + vals[2]
             return [s & mask, s >> bits]
 
-        cs.set_values_with_dependencies([a, b, carry_in], [c, cout], resolve)
+        from ...native import OP_U32_ADD
+
+        cs.set_values_with_dependencies(
+            [a, b, carry_in], [c, cout], resolve,
+            native=(OP_U32_ADD, (bits,)),
+        )
         cs.place_gate(self, [a, b, carry_in, c, cout], ())
         return c, cout

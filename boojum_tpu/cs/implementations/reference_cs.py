@@ -21,7 +21,7 @@ import numpy as np
 
 from ...field import gl
 from ..types import CSGeometry, CSConfig, DEV_CS_CONFIG, LookupParameters
-from ...dag import WitnessResolver, NullResolver
+from ...dag import NullResolver, make_resolver
 from ..gates.base import Gate
 from ..gates.simple import ConstantsAllocatorGate
 
@@ -39,7 +39,7 @@ class ConstraintSystem:
         self.config = config
         self.lookup_params = lookup_params or LookupParameters()
         self.resolver = (
-            WitnessResolver() if config.evaluate_witness else NullResolver()
+            make_resolver() if config.evaluate_witness else NullResolver()
         )
         self.next_var_idx = 0
         self.next_wit_idx = 0
@@ -87,9 +87,11 @@ class ConstraintSystem:
         self.resolver.set_value(p, value % gl.P)
         return p
 
-    def set_values_with_dependencies(self, ins, outs, fn):
-        """Register a witness closure (reference cs.rs:112)."""
-        self.resolver.add_resolution(ins, outs, fn)
+    def set_values_with_dependencies(self, ins, outs, fn, native=None, table=None):
+        """Register a witness closure (reference cs.rs:112). `native` is an
+        optional typed-op descriptor for the native tape engine; `fn` remains
+        the portable fallback."""
+        self.resolver.add_resolution(ins, outs, fn, native=native, table=table)
 
     def get_value(self, place: int) -> int:
         return self.resolver.get_value(place)
@@ -225,10 +227,17 @@ class ConstraintSystem:
                 )
                 return []
 
-            self.resolver.add_resolution(list(keys), [], bump)
+            from ...native import OP_LOOKUP_BUMP
+
+            self.resolver.add_resolution(
+                list(keys[: table.width]), [], bump,
+                native=(OP_LOOKUP_BUMP, (table_id,)), table=table,
+            )
 
     def perform_lookup(self, table_id: int, key_places: list[int]) -> list[int]:
         """Allocate output variables = table lookup of key variables."""
+        from ...native import OP_LOOKUP
+
         table = self.get_table(table_id)
         num_outs = table.num_values
         outs = self.alloc_multiple_variables_without_values(num_outs)
@@ -236,7 +245,10 @@ class ConstraintSystem:
         def resolve(vals, table=table):
             return list(table.lookup_values(tuple(vals)))
 
-        self.set_values_with_dependencies(list(key_places), outs, resolve)
+        self.set_values_with_dependencies(
+            list(key_places), outs, resolve,
+            native=(OP_LOOKUP, (table_id,)), table=table,
+        )
         self.enforce_lookup(table_id, list(key_places) + outs)
         return outs
 
@@ -362,7 +374,6 @@ class ConstraintSystem:
         return placement, table_id_col
 
     def into_assembly(self) -> "CSAssembly":
-        self.resolver.wait_till_resolved()
         n = getattr(self, "trace_len", None) or self.pad_and_shrink()
         lookups_on = bool(self.lookup_rows) or (
             self.lookup_params.is_enabled and bool(self.lookup_tables)
@@ -372,6 +383,9 @@ class ConstraintSystem:
         else:
             lookup_placement = np.zeros((0, n), dtype=np.int64)
             table_id_col = None
+        # AFTER padding/lookup placement (both may register resolutions):
+        # force every pending resolution — incl. the native tape — to fire
+        self.resolver.wait_till_resolved()
         num_places = 2 * max(self.next_var_idx, self.next_wit_idx) + 2
         arena = self.resolver.values
         if len(arena) < num_places:
@@ -402,6 +416,14 @@ class ConstraintSystem:
             if self.config.evaluate_witness:
                 for (tid, row_idx), cnt in self.lookup_multiplicities.items():
                     multiplicities[table_offsets[tid] + row_idx] = cnt
+                # merge counters bumped by the native tape engine
+                for tid in range(1, len(self.lookup_tables) + 1):
+                    nm = self.resolver.native_multiplicities(tid)
+                    if nm is not None:
+                        off = table_offsets[tid]
+                        multiplicities[off : off + len(nm)] += nm.astype(
+                            np.uint64
+                        )
         return CSAssembly(
             geometry=self.geometry,
             lookup_params=self.lookup_params,

@@ -1,1 +1,6 @@
-from .resolver import WitnessResolver, NullResolver
+from .resolver import (
+    NativeTapeResolver,
+    NullResolver,
+    WitnessResolver,
+    make_resolver,
+)

@@ -65,10 +65,12 @@ class WitnessResolver:
 
     # -- resolutions --------------------------------------------------------
 
-    def add_resolution(self, ins: list, outs: list, fn):
+    def add_resolution(self, ins: list, outs: list, fn, native=None, table=None):
         """Register fn(list_of_input_ints) -> list_of_output_ints.
 
-        Runs immediately if all inputs are resolved (the hot path).
+        Runs immediately if all inputs are resolved (the hot path). `native`
+        (a typed-op descriptor) and `table` are accepted for signature parity
+        with NativeTapeResolver and ignored here.
         """
         missing = [p for p in ins if not self.is_resolved(p)]
         if not missing:
@@ -78,6 +80,10 @@ class WitnessResolver:
         self._num_pending += 1
         for p in missing:
             self._waiters.setdefault(p, []).append(rec)
+
+    def native_multiplicities(self, table_id: int):
+        """Lookup-multiplicity bumps executed natively (none here)."""
+        return None
 
     def _run(self, ins, outs, fn):
         in_vals = [int(self.values[p]) for p in ins]
@@ -104,6 +110,103 @@ class WitnessResolver:
         return self.values[:count]
 
 
+class NativeTapeResolver(WitnessResolver):
+    """Witness resolver backed by the C++ typed-op tape engine
+    (`boojum_tpu.native`): gadget helpers that provide a typed descriptor are
+    recorded on a tape and executed natively in batches; anything else runs
+    through the python-closure path. Flushes happen lazily — on the first
+    read of a tape-pending place, when a python closure needs one, or at
+    `wait_till_resolved`.
+
+    This is the host-side analogue of the reference's compiled resolver
+    pipeline (dag/resolvers/mt/resolution_window.rs): same dataflow
+    semantics, with the "worker" being one vectorized native pass instead of
+    a thread pool.
+    """
+
+    def __init__(self, lib, capacity: int = 1 << 16):
+        super().__init__(capacity=capacity)
+        from ..native import NativeTape
+
+        self._tape = NativeTape(lib)
+        self._pending: set[int] = set()
+        self._max_place = -1
+
+    def _available(self, place: int) -> bool:
+        return (
+            place < len(self.resolved) and bool(self.resolved[place])
+        ) or place in self._pending
+
+    def flush(self):
+        if not len(self._tape):
+            return
+        self._ensure(self._max_place)
+        out_places = self._tape.execute(self.values)
+        self.resolved[np.array(out_places, dtype=np.int64)] = True
+        self._pending.clear()
+        # fire python waiters parked on natively-resolved places
+        if self._waiters:
+            fired = [
+                p
+                for p in self._waiters
+                if p < len(self.resolved) and self.resolved[p]
+            ]
+            for p in fired:
+                for rec in self._waiters.pop(p):
+                    rec[0] -= 1
+                    if rec[0] == 0:
+                        self._num_pending -= 1
+                        self._run(rec[1], rec[2], rec[3])
+
+    def is_resolved(self, place: int) -> bool:
+        if place in self._pending:
+            self.flush()
+        return super().is_resolved(place)
+
+    def get_value(self, place: int) -> int:
+        if place in self._pending:
+            self.flush()
+        return super().get_value(place)
+
+    def add_resolution(self, ins, outs, fn, native=None, table=None):
+        if native is not None and all(self._available(p) for p in ins):
+            kind, params = native
+            if table is not None:
+                self._tape.ensure_table(int(params[0]), table)
+                params = (self._tape.slot_of(int(params[0])),)
+            self._tape.append(kind, params, ins, outs)
+            if outs:
+                self._pending.update(outs)
+                m = max(outs)
+                if m > self._max_place:
+                    self._max_place = m
+            return
+        if native is not None:
+            # inputs not all available natively: fall back to the closure
+            # path, flushing first so tape-pending inputs materialize
+            if any(p in self._pending for p in ins):
+                self.flush()
+        super().add_resolution(ins, outs, fn)
+
+    def wait_till_resolved(self):
+        self.flush()
+        super().wait_till_resolved()
+
+    def native_multiplicities(self, table_id: int):
+        return self._tape.multiplicities_of(table_id)
+
+
+def make_resolver(capacity: int = 1 << 16) -> WitnessResolver:
+    """The default witness resolver: native tape engine when the C++ library
+    is available (BOOJUM_TPU_NO_NATIVE=1 opts out), else pure python."""
+    from ..native import get_lib
+
+    lib = get_lib()
+    if lib is not None:
+        return NativeTapeResolver(lib, capacity=capacity)
+    return WitnessResolver(capacity=capacity)
+
+
 class NullResolver(WitnessResolver):
     """Setup-mode no-op resolver (reference NullCircuitResolver,
     dag/resolvers/null.rs): accepts registrations, stores nothing."""
@@ -114,7 +217,7 @@ class NullResolver(WitnessResolver):
     def set_value(self, place: int, value: int):
         pass
 
-    def add_resolution(self, ins, outs, fn):
+    def add_resolution(self, ins, outs, fn, native=None, table=None):
         pass
 
     def is_resolved(self, place: int) -> bool:

@@ -7,6 +7,7 @@ uint64 arrays; host-side scalars are `(int, int)` tuples (functions suffixed
 `_s`). All Fiat–Shamir challenges drawn after witness commitment live here.
 """
 
+import jax
 import jax.numpy as jnp
 
 from . import goldilocks as gf
@@ -66,6 +67,7 @@ def inv(a):
     return (gf.mul(a[0], dinv), gf.neg(gf.mul(a[1], dinv)))
 
 
+@jax.jit
 def batch_inverse(a):
     d = gf.sub(gf.sqr(a[0]), gf.mul_small(gf.sqr(a[1]), NON_RESIDUE))
     dinv = gf.batch_inverse(d)

@@ -145,24 +145,42 @@ def pow_const(a: jax.Array, e: int) -> jax.Array:
     return result
 
 
+@jax.jit
 def inv(a: jax.Array) -> jax.Array:
-    """Fermat inverse a^(p-2); inverse of 0 is 0 (callers must avoid it)."""
+    """Fermat inverse a^(p-2); inverse of 0 is 0 (callers must avoid it).
+
+    Jitted: the square-and-multiply chain is ~90 muls — one compile per
+    shape instead of ~1500 eager primitive dispatches per call."""
     return pow_const(a, P_INT - 2)
 
 
+def prefix_product(a: jax.Array) -> jax.Array:
+    """Inclusive modular prefix product along the last axis via log-doubling
+    (Hillis–Steele): log2(n) rounds of shift+multiply. Deliberately NOT
+    lax.associative_scan — its recursive slicing graph makes XLA compile
+    time blow up on wide combine functions; this form compiles flat."""
+    n = a.shape[-1]
+    shift = 1
+    while shift < n:
+        ones = jnp.ones(a.shape[:-1] + (shift,), a.dtype)
+        shifted = jnp.concatenate([ones, a[..., :-shift]], axis=-1)
+        a = mul(a, shifted)
+        shift *= 2
+    return a
+
+
+@jax.jit
 def batch_inverse(a: jax.Array) -> jax.Array:
     """Montgomery batch inversion along the last axis.
 
-    Two modular prefix-product scans plus ONE Fermat inversion, the
-    `associative_scan` counterpart of the reference's serial Montgomery trick
-    (`/root/reference/src/cs/implementations/utils.rs:405`).
+    Two modular prefix-product passes plus ONE Fermat inversion (the
+    vectorized counterpart of the reference's serial Montgomery trick,
+    `/root/reference/src/cs/implementations/utils.rs:405`).
     """
-    prefix = jax.lax.associative_scan(mul, a, axis=-1)
+    prefix = prefix_product(a)
     total_inv = inv(prefix[..., -1:])
-    # suffix[i] = inv(prod of a[..i]) ; build by reverse scan of inverses
-    # inv_prefix[i] = total_inv * prod(a[i+1:])
     rev = jnp.flip(a, axis=-1)
-    rev_prefix = jax.lax.associative_scan(mul, rev, axis=-1)
+    rev_prefix = prefix_product(rev)
     # prod(a[i+1:]) = rev_prefix[n-2-i] for i < n-1, 1 for i = n-1
     suffix = jnp.concatenate(
         [jnp.flip(rev_prefix[..., :-1], axis=-1), jnp.ones_like(a[..., :1])],

@@ -76,7 +76,11 @@ def decompose_and_check(cs, var, num_bits):
         x = vals[0]
         return [(x >> (4 * i)) & MASK4 for i in range(k)]
 
-    cs.set_values_with_dependencies([var], chunks, resolve)
+    from ..native import OP_SPLIT
+
+    cs.set_values_with_dependencies(
+        [var], chunks, resolve, native=(OP_SPLIT, (4,))
+    )
     enforce_chunk_recomposition(cs, chunks, var)
     range_check_chunks_batched(cs, chunks)
     return chunks

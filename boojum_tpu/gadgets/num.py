@@ -120,7 +120,11 @@ class Num:
             x = vals[0]
             return [(x >> i) & 1 for i in range(num_bits)]
 
-        cs.set_values_with_dependencies([self.var], bits, resolve)
+        from ..native import OP_SPLIT
+
+        cs.set_values_with_dependencies(
+            [self.var], bits, resolve, native=(OP_SPLIT, (1,))
+        )
         for b in bits:
             BooleanConstraintGate.enforce(cs, b)
         from .chunk_utils import enforce_chunk_recomposition

@@ -99,7 +99,11 @@ class UInt32(UIntX):
             x = vals[0]
             return [(x >> (8 * i)) & 0xFF for i in range(4)]
 
-        cs.set_values_with_dependencies([self.var], outs, resolve)
+        from ..native import OP_SPLIT
+
+        cs.set_values_with_dependencies(
+            [self.var], outs, resolve, native=(OP_SPLIT, (8,))
+        )
         ReductionGate.enforce_reduce(
             cs, list(outs), [1, 1 << 8, 1 << 16, 1 << 24], self.var
         )

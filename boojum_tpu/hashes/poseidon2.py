@@ -71,23 +71,30 @@ def _internal_mds(state):
     return gf.add(scaled, total[..., None])
 
 
+@jax.jit
 def poseidon2_permutation(state: jax.Array) -> jax.Array:
-    """Batched Poseidon2 permutation on (..., 12) uint64 arrays."""
+    """Batched Poseidon2 permutation on (..., 12) uint64 arrays.
+
+    Rounds run under `lax.fori_loop` (compiler-friendly control flow): the
+    compiled graph is one round body per phase instead of 30 unrolled rounds,
+    which keeps XLA compile time flat while the loop itself is negligible
+    next to the field ops."""
     rc = jnp.asarray(_RC)
+
+    def full_round(r, s):
+        s = gf.add(s, rc[r])
+        s = _sbox7(s)
+        return _external_mds(s)
+
+    def partial_round(r, s):
+        el0 = _sbox7(gf.add(s[..., 0], rc[r, 0]))
+        s = jnp.concatenate([el0[..., None], s[..., 1:]], axis=-1)
+        return _internal_mds(s)
+
     state = _external_mds(state)
-    for r in range(4):
-        state = gf.add(state, rc[r])
-        state = _sbox7(state)
-        state = _external_mds(state)
-    for r in range(4, 26):
-        el0 = gf.add(state[..., 0], rc[r, 0])
-        el0 = _sbox7(el0)
-        state = jnp.concatenate([el0[..., None], state[..., 1:]], axis=-1)
-        state = _internal_mds(state)
-    for r in range(26, 30):
-        state = gf.add(state, rc[r])
-        state = _sbox7(state)
-        state = _external_mds(state)
+    state = jax.lax.fori_loop(0, 4, full_round, state)
+    state = jax.lax.fori_loop(4, 26, partial_round, state)
+    state = jax.lax.fori_loop(26, 30, full_round, state)
     return state
 
 
@@ -96,6 +103,7 @@ def poseidon2_permutation(state: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+@jax.jit
 def leaf_hash(values: jax.Array) -> jax.Array:
     """Hash (..., L) field values into (..., 4) leaf digests.
 
@@ -120,6 +128,7 @@ def leaf_hash(values: jax.Array) -> jax.Array:
     return state[..., :4]
 
 
+@jax.jit
 def node_hash(left: jax.Array, right: jax.Array) -> jax.Array:
     """Hash two (..., 4) digests into a (..., 4) parent digest."""
     state = jnp.concatenate(

@@ -18,7 +18,7 @@ Domain conventions (chosen so FRI pairing and Merkle layout are contiguous):
   (x, -x) are then adjacent.
 """
 
-from functools import lru_cache
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,7 @@ def get_ntt_context(log_n: int) -> NTTContext:
     return NTTContext(log_n)
 
 
+@partial(jax.jit, static_argnums=(1,))
 def fft_natural_to_bitreversed(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
     """DIF NTT along the last axis; output in bit-reversed order."""
     n = a.shape[-1]
@@ -101,6 +102,7 @@ def fft_natural_to_bitreversed(a: jax.Array, ctx: NTTContext | None = None) -> j
     return a
 
 
+@partial(jax.jit, static_argnums=(1,))
 def ifft_bitreversed_to_natural(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
     """DIT inverse NTT along the last axis; input bit-reversed, output natural.
 
@@ -134,12 +136,14 @@ def ifft_natural_to_natural(a: jax.Array, ctx: NTTContext | None = None) -> jax.
     return ifft_bitreversed_to_natural(a[..., ctx.brev], ctx)
 
 
+@partial(jax.jit, static_argnums=(1,))
 def distribute_powers(a: jax.Array, base: int) -> jax.Array:
     """a[..., i] *= base^i (the coset shift before a forward transform)."""
     n = a.shape[-1]
     return gf.mul(a, powers_device(base, n))
 
 
+@partial(jax.jit, static_argnums=(1, 2))
 def lde_from_monomial(
     coeffs: jax.Array,
     lde_factor: int,
@@ -165,9 +169,18 @@ def lde_from_monomial(
     return fft_natural_to_bitreversed(scaled, ctx)
 
 
+@jax.jit
 def monomial_from_values(values: jax.Array) -> jax.Array:
     """Values over H (natural order) -> monomial coefficients."""
     return ifft_natural_to_natural(values)
+
+
+@jax.jit
+def _eval_with_pows(coeffs: jax.Array, p0: jax.Array, p1: jax.Array):
+    c0 = gf.mul(coeffs, p0)
+    c1 = gf.mul(coeffs, p1)
+    # sum over last axis, mod p: reduce via pairwise modular adds
+    return (_modsum(c0), _modsum(c1))
 
 
 def eval_monomial_at_ext_point(coeffs: jax.Array, z, z_pows=None):
@@ -176,15 +189,14 @@ def eval_monomial_at_ext_point(coeffs: jax.Array, z, z_pows=None):
     z is a host scalar (c0, c1); returns ext pair of shape (...,). Uses a
     power table + reduction instead of a sequential Horner chain (the
     device-friendly analogue of the reference's barycentric evaluation,
-    `/root/reference/src/cs/implementations/utils.rs:1025`).
+    `/root/reference/src/cs/implementations/utils.rs:1025`). The reduction
+    core is jitted; the z-dependent power table stays an array argument so
+    new challenges never retrace.
     """
     n = coeffs.shape[-1]
     if z_pows is None:
         z_pows = ext_powers_device(z, n)
-    c0 = gf.mul(coeffs, z_pows[0])
-    c1 = gf.mul(coeffs, z_pows[1])
-    # sum over last axis, mod p: reduce via pairwise modular adds
-    return (_modsum(c0), _modsum(c1))
+    return _eval_with_pows(coeffs, z_pows[0], z_pows[1])
 
 
 def ext_powers_device(z, count: int):

@@ -59,11 +59,10 @@ def leaf_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(("col", "row"), None))
 
 
-def _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma):
-    """Copy-permutation grand-product numerator/denominator accumulation and
-    the z poly, all-column form (see stages.compute_copy_permutation_stage2;
-    this fragment keeps the per-column products column-sharded and lets the
-    scan run on the replicated row axis)."""
+def _num_den_products(copy_vals, sigma_vals, non_residues, beta, gamma):
+    """Copy-permutation numerator/denominator column products (column axis
+    collapses via a log tree of ext muls; with a column-sharded operand, XLA
+    turns the tree into a psum-style reduction over ICI)."""
     C, n = copy_vals.shape
     omega = gl.omega(n.bit_length() - 1)
     xs = powers_device(omega, n)
@@ -79,8 +78,7 @@ def _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma):
         gf.add(gf.add(copy_vals, gf.mul(sigma_vals, b0)), g0),
         gf.add(gf.mul(sigma_vals, b1), g1),
     )
-    # product across the column axis (log-depth tree of ext muls; XLA turns
-    # the column-sharded operand into a psum-style tree over ICI)
+
     def tree_prod(pair):
         c0, c1 = pair
         while c0.shape[0] > 1:
@@ -91,10 +89,15 @@ def _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma):
             c0, c1 = ext_f.mul((c0[:h], c1[:h]), (c0[h:], c1[h:]))
         return c0[0], c1[0]
 
-    num_p = tree_prod(num)
-    den_p = tree_prod(den)
-    ratio = ext_f.mul(num_p, ext_f.batch_inverse(den_p))
-    incl = jax.lax.associative_scan(ext_f.mul, ratio, axis=-1)
+    return tree_prod(num), tree_prod(den)
+
+
+def _z_from_ratio(ratio):
+    """Exclusive prefix product of the per-row ratio (shared log-doubling
+    scan — see prover.stages._ext_prefix_prod)."""
+    from ..prover.stages import _ext_prefix_prod
+
+    incl = _ext_prefix_prod(ratio)
     one = jnp.ones((1,), jnp.uint64)
     zero = jnp.zeros((1,), jnp.uint64)
     return (
@@ -103,10 +106,9 @@ def _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma):
     )
 
 
-def _prove_fragment(copy_vals, sigma_vals, non_residues, beta, gamma,
-                    lde_factor, cap_size, mesh):
-    """Rounds 1+2 core: per-column iNTT -> coset LDE -> Merkle digest layers
-    (with the col->row layout pivot) and the copy-permutation z poly."""
+def _commit_fragment(copy_vals, lde_factor, cap_size, mesh):
+    """Per-column iNTT -> coset LDE -> Merkle digest layers with the
+    col->row layout pivot."""
     C, n = copy_vals.shape
     mono = monomial_from_values(copy_vals)  # column-sharded, no comm
     lde = lde_from_monomial(mono, lde_factor)  # (C, L, n) still per-column
@@ -115,26 +117,55 @@ def _prove_fragment(copy_vals, sigma_vals, non_residues, beta, gamma,
     digests = leaf_hash(leaves)  # (L*n, 4) row-sharded
     while digests.shape[0] > cap_size:
         digests = node_hash(digests[0::2], digests[1::2])
-    cap = jax.lax.with_sharding_constraint(
+    return jax.lax.with_sharding_constraint(
         digests, NamedSharding(mesh, P(None, None))
     )
-    z = _grand_product_z(copy_vals, sigma_vals, non_residues, beta, gamma)
+
+
+def _prove_fragment(copy_vals, sigma_vals, non_residues, beta, gamma,
+                    lde_factor, cap_size, mesh):
+    """Single-graph form of the rounds-1+2 core (used by the driver's
+    single-chip COMPILE check; execution goes through the sequenced phases
+    of sharded_prove_fragment)."""
+    cap = _commit_fragment(copy_vals, lde_factor, cap_size, mesh)
+    num_p, den_p = _num_den_products(
+        copy_vals, sigma_vals, non_residues, beta, gamma
+    )
+    ratio = ext_f.mul(num_p, ext_f.batch_inverse(den_p))
+    z = _z_from_ratio(ratio)
     return cap, z
 
 
 def sharded_prove_fragment(mesh: Mesh, lde_factor: int = 4, cap_size: int = 4):
-    """Jit the prove fragment with column-sharded inputs over `mesh`.
+    """The prove fragment over `mesh`, as a SEQUENCE of jitted phases.
 
     Inputs: copy_vals/sigma_vals (C, n) uint64; non_residues (C,) uint64;
     beta/gamma (2,) uint64 extension scalars.
+
+    Phased rather than one fused jit for two reasons: the extension-field
+    batch inversion must sit at a top-level jit boundary (XLA:CPU has
+    produced never-terminating executables when its inversion chain is
+    inlined into large modules — see prover/stages.py), and each phase's
+    GSPMD partitioning stays small and predictable.
     """
     cs = col_sharding(mesh)
     rep = NamedSharding(mesh, P())
 
-    def run(copy_vals, sigma_vals, non_residues, beta, gamma):
-        return _prove_fragment(
-            copy_vals, sigma_vals, non_residues, beta, gamma,
-            lde_factor, cap_size, mesh,
-        )
+    commit = jax.jit(
+        lambda cv: _commit_fragment(cv, lde_factor, cap_size, mesh),
+        in_shardings=(cs,),
+    )
+    numden = jax.jit(
+        _num_den_products, in_shardings=(cs, cs, rep, rep, rep)
+    )
+    ratio_z = jax.jit(
+        lambda num_p, den_inv: _z_from_ratio(ext_f.mul(num_p, den_inv))
+    )
 
-    return jax.jit(run, in_shardings=(cs, cs, rep, rep, rep))
+    def run(copy_vals, sigma_vals, non_residues, beta, gamma):
+        cap = commit(copy_vals)
+        num_p, den_p = numden(copy_vals, sigma_vals, non_residues, beta, gamma)
+        den_inv = ext_f.batch_inverse(den_p)
+        return cap, ratio_z(num_p, den_inv)
+
+    return run

@@ -10,6 +10,7 @@ interleaves (c0, c1) with two domain points per Merkle leaf.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -48,12 +49,8 @@ def fold_challenge_tables(log_full: int, num_rounds: int):
     return tables
 
 
-def fold_once(values, challenge, inv_x_pairs):
-    """values: ext pair over round-r domain (brev layout); returns N/2 ext.
-
-    f'(x^2) = (f(x)+f(-x))/2 + ch·(f(x)-f(-x))/(2x).
-    """
-    ch = ext_scalar(challenge)
+@jax.jit
+def _fold_once_jit(values, ch, inv_x_pairs):
     a = (values[0][0::2], values[1][0::2])
     bm = (values[0][1::2], values[1][1::2])
     s = ext_f.add(a, bm)
@@ -62,6 +59,15 @@ def fold_once(values, challenge, inv_x_pairs):
     t = ext_f.add(s, ext_f.mul(d_over_x, ch))
     inv2 = jnp.uint64(INV2)
     return (gf.mul(t[0], inv2), gf.mul(t[1], inv2))
+
+
+def fold_once(values, challenge, inv_x_pairs):
+    """values: ext pair over round-r domain (brev layout); returns N/2 ext.
+
+    f'(x^2) = (f(x)+f(-x))/2 + ch·(f(x)-f(-x))/(2x). Jitted core with the
+    challenge as an array argument (new challenges never retrace).
+    """
+    return _fold_once_jit(values, ext_scalar(challenge), inv_x_pairs)
 
 
 def commit_codeword(values, cap_size: int) -> MerkleTreeWithCap:

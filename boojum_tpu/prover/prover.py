@@ -22,6 +22,7 @@ the host only sequences rounds, runs the transcript, and gathers query paths.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -46,14 +47,33 @@ from .fri import fri_prove
 from .pow import pow_grind
 from .proof import OracleQuery, Proof, SingleRoundQueries
 from .stages import (
-    alpha_powers_iter,
+    AlphaPows,
     compute_copy_permutation_stage2,
     compute_lookup_polys,
     copy_permutation_quotient_terms,
-    ext_scalar,
     gate_terms_contribution,
     lookup_quotient_terms,
+    num_gate_sweep_terms,
 )
+
+
+@jax.jit
+def _deep_main_sum(all_lde_flat, y0s, y1s, c0s, c1s, inv_xz):
+    """Σ_i ch_i·(f_i − y_i)/(x − z) over all opened columns, as one scan
+    (keeps memory O(N) while compiling to a single graph)."""
+
+    def body(h, inputs):
+        f, y0, y1, c0, c1 = inputs
+        num = (gf.sub(f, y0), gf.neg(jnp.broadcast_to(y1, f.shape)))
+        term = ext_f.mul(ext_f.mul(num, inv_xz), (c0, c1))
+        return (gf.add(h[0], term[0]), gf.add(h[1], term[1])), None
+
+    init = (
+        jnp.zeros_like(all_lde_flat[0]),
+        jnp.zeros_like(all_lde_flat[0]),
+    )
+    h, _ = jax.lax.scan(body, init, (all_lde_flat, y0s, y1s, c0s, c1s))
+    return h
 
 
 def _commit_columns(lde, cap_size):
@@ -208,15 +228,20 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         for j in range(num_partials)
     ]
 
-    alpha_iter = alpha_powers_iter(alpha)
+    total_alpha_terms = (
+        num_gate_sweep_terms(assembly)
+        + 1 + len(chunks)
+        + ((lp.num_repetitions + 1) if lookups else 0)
+    )
+    alpha_pows = AlphaPows(alpha, total_alpha_terms)
     acc = gate_terms_contribution(
         assembly, setup.selector_paths, copy_lde_flat[:Cg], gate_wit_lde,
-        const_lde_flat, setup.selector_depth, alpha_iter, (N,),
+        const_lde_flat, setup.selector_depth, alpha_pows, (N,),
     )
     cp_acc = copy_permutation_quotient_terms(
         z_lde, z_shift_lde, partial_ldes, chunks, copy_lde_flat,
         sigma_lde_flat, setup.non_residues, xs_lde, l0, beta, gamma,
-        alpha_iter,
+        alpha_pows,
     )
     acc = cp_acc if acc is None else ext_f.add(acc, cp_acc)
     if lookups:
@@ -232,7 +257,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         lk_acc = lookup_quotient_terms(
             a_ldes, b_lde, copy_lde_flat[Cg:], const_lde_flat[K - 1],
             table_lde_flat, wit_lde_all[Ct + W], lookup_beta, lookup_gamma,
-            lp.num_repetitions, lp.width, alpha_iter,
+            lp.num_repetitions, lp.width, alpha_pows,
         )
         acc = ext_f.add(acc, lk_acc)
     zh_inv = _vanishing_inv_brev(log_n, L)
@@ -304,20 +329,24 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
                   jnp.broadcast_to(jnp.uint64(gl.neg(zw[1])), xs_lde.shape))
     inv_xzw = ext_f.batch_inverse(x_minus_zw)
 
-    h = None
-    ch_iter = alpha_powers_iter(deep_ch)
-    for i in range(B):
-        ch = ext_scalar(next(ch_iter))
-        y = values_at_z[i]
-        num = (
-            gf.sub(all_lde_flat[i], jnp.uint64(y[0])),
-            jnp.broadcast_to(jnp.uint64(gl.neg(y[1])), xs_lde.shape),
-        )
-        term = ext_f.mul(ext_f.mul(num, inv_xz), ch)
-        h = term if h is None else ext_f.add(h, term)
+    num_deep_terms = (
+        B + 2
+        + ((lp.num_repetitions + 1) if lookups else 0)
+        + len(assembly.public_inputs)
+    )
+    deep_pows = AlphaPows(deep_ch, num_deep_terms)
+    c0s, c1s = deep_pows.take(B)
+    y0s = jnp.asarray(
+        np.array([v[0] for v in values_at_z], dtype=np.uint64)
+    )
+    y1s = jnp.asarray(
+        np.array([v[1] for v in values_at_z], dtype=np.uint64)
+    )
+    h = _deep_main_sum(all_lde_flat, y0s, y1s, c0s, c1s, inv_xz)
     # z-poly at z*omega
     for i in range(2):
-        ch = ext_scalar(next(ch_iter))
+        c0, c1 = deep_pows.take(1)
+        ch = (c0[0], c1[0])
         y = values_at_z_omega[i]
         num = (
             gf.sub(s2_lde_flat[i], jnp.uint64(y[0])),
@@ -330,7 +359,8 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         inv_x = gf.batch_inverse(xs_lde)
         ab_off = 2 + 2 * num_partials
         for i in range(lp.num_repetitions + 1):
-            ch = ext_scalar(next(ch_iter))
+            c0, c1 = deep_pows.take(1)
+            ch = (c0[0], c1[0])
             v0, v1 = values_at_0[i]
             num = (
                 gf.sub(s2_lde_flat[ab_off + 2 * i], jnp.uint64(v0)),
@@ -345,7 +375,8 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
             jnp.stack([gf.sub(xs_lde, jnp.uint64(p)) for p in pi_points])
         )
         for k, (col, _row, value) in enumerate(assembly.public_inputs):
-            ch = ext_scalar(next(ch_iter))
+            c0, c1 = deep_pows.take(1)
+            ch = (c0[0], c1[0])
             num = gf.sub(wit_lde_all[col], jnp.uint64(value))
             term_base = gf.mul(num, denoms[k])
             h = ext_f.add(h, (gf.mul(term_base, ch[0]), gf.mul(term_base, ch[1])))

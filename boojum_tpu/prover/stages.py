@@ -16,6 +16,8 @@ form that suits SIMD/MXU hardware.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -45,6 +47,56 @@ def chunk_columns(num_cols: int, max_degree: int):
     return [list(range(i, min(i + cs, num_cols))) for i in range(0, num_cols, cs)]
 
 
+@jax.jit
+def _chunk_num_den(w_chunk, s_chunk, k_chunk, xs, b, g):
+    """Π over the chunk's columns of numerator (w + β·k·x + γ) and
+    denominator (w + β·σ + γ) — one small compiled graph, reused for every
+    chunk of the same width. The denominator inversion happens OUTSIDE this
+    jit: batch_inverse must stay a top-level jit boundary — inlining its
+    Fermat-chain into larger XLA:CPU modules has produced never-terminating
+    executables on this backend (miscompile class, not a slowness issue)."""
+    m = w_chunk.shape[0]
+    num_p = None
+    den_p = None
+    for j in range(m):
+        w = w_chunk[j]
+        kx = gf.mul(xs, k_chunk[j])
+        num = (
+            gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
+            gf.add(gf.mul(kx, b[1]), g[1]),
+        )
+        s = s_chunk[j]
+        den = (
+            gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
+            gf.add(gf.mul(s, b[1]), g[1]),
+        )
+        num_p = num if num_p is None else ext_f.mul(num_p, num)
+        den_p = den if den_p is None else ext_f.mul(den_p, den)
+    return num_p, den_p
+
+
+def _chunk_ratio(w_chunk, s_chunk, k_chunk, xs, b, g):
+    num_p, den_p = _chunk_num_den(w_chunk, s_chunk, k_chunk, xs, b, g)
+    return ext_f.mul(num_p, ext_f.batch_inverse(den_p))
+
+
+@jax.jit
+def _ext_prefix_prod(a):
+    """Inclusive ext prefix product along the last axis (log-doubling; same
+    rationale as gf.prefix_product — associative_scan's graph explodes XLA
+    compile time for wide combine fns)."""
+    n = a[0].shape[-1]
+    shift = 1
+    while shift < n:
+        shifted = (
+            jnp.concatenate([jnp.ones((shift,), jnp.uint64), a[0][:-shift]]),
+            jnp.concatenate([jnp.zeros((shift,), jnp.uint64), a[1][:-shift]]),
+        )
+        a = ext_f.mul(a, shifted)
+        shift *= 2
+    return a
+
+
 def compute_copy_permutation_stage2(
     copy_vals, sigma_vals, non_residues, beta, gamma, max_degree
 ):
@@ -54,6 +106,10 @@ def compute_copy_permutation_stage2(
     beta/gamma host ext scalars. Returns (z_pair, partial_pairs, chunks)
     where z(w^0)=1 and for the last chunk relation
     z(w*x)·prod_den_last = p_last·prod_num_last holds.
+
+    Deliberately NOT one fused jit: XLA:CPU optimization time is superlinear
+    in module size, so this sequences a handful of small jitted kernels
+    (per-chunk ratio, batch inverse, prefix product) instead.
     """
     C, n = copy_vals.shape
     ctx = get_ntt_context(n.bit_length() - 1)
@@ -61,42 +117,22 @@ def compute_copy_permutation_stage2(
     b = ext_scalar(beta)
     g = ext_scalar(gamma)
     chunks = chunk_columns(C, max_degree)
-    ks = [jnp.uint64(k) for k in non_residues]
-
-    def num_den_for_col(j):
-        w = copy_vals[j]
-        kx = gf.mul(xs, ks[j])
-        num = (
-            gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
-            gf.add(gf.mul(kx, b[1]), g[1]),
-        )
-        s = sigma_vals[j]
-        den = (
-            gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
-            gf.add(gf.mul(s, b[1]), g[1]),
-        )
-        return num, den
+    ks = jnp.asarray(np.array([int(k) for k in non_residues], dtype=np.uint64))
 
     chunk_ratios = []
     for chunk in chunks:
-        num_p = None
-        den_p = None
-        for j in chunk:
-            num, den = num_den_for_col(j)
-            num_p = num if num_p is None else ext_f.mul(num_p, num)
-            den_p = den if den_p is None else ext_f.mul(den_p, den)
-        ratio = ext_f.mul(num_p, ext_f.batch_inverse(den_p))
-        chunk_ratios.append(ratio)
+        lo, hi = chunk[0], chunk[-1] + 1
+        chunk_ratios.append(
+            _chunk_ratio(
+                copy_vals[lo:hi], sigma_vals[lo:hi], ks[lo:hi], xs, b, g
+            )
+        )
 
     full_ratio = chunk_ratios[0]
     for r in chunk_ratios[1:]:
         full_ratio = ext_f.mul(full_ratio, r)
 
-    # z = exclusive prefix product of full_ratio along rows
-    def emul(a, b):
-        return ext_f.mul(a, b)
-
-    incl = jax.lax.associative_scan(emul, full_ratio, axis=-1)
+    incl = _ext_prefix_prod(full_ratio)
     one = jnp.ones((1,), jnp.uint64)
     zero = jnp.zeros((1,), jnp.uint64)
     z = (
@@ -144,18 +180,29 @@ def selector_poly_lde(const_lde_flat, path):
     return sel  # None = constant 1 (single-gate circuits)
 
 
-def alpha_powers_iter(alpha):
-    """Infinite iterator of host ext powers 1, a, a^2, ..."""
-    cur = ext_f.ONE_S
-    a = (int(alpha[0]), int(alpha[1]))
-    while True:
-        yield cur
-        cur = ext_f.mul_s(cur, a)
+class AlphaPows:
+    """Challenge-power supply for the quotient sweep: a device array of ext
+    powers consumed sequentially (so jitted stages take them as array
+    arguments and new challenges never retrace)."""
+
+    def __init__(self, alpha, count: int):
+        from ..ntt import ext_powers_device
+
+        cap = 1
+        while cap < max(count, 1):
+            cap *= 2
+        self.p0, self.p1 = ext_powers_device(alpha, cap)
+        self.cursor = 0
+
+    def take(self, k: int):
+        """(k,)-shaped ext power pair slice."""
+        s = slice(self.cursor, self.cursor + k)
+        self.cursor += k
+        return (self.p0[s], self.p1[s])
 
 
-def accumulate_ext(acc, term_base, challenge):
-    """acc += challenge * term for base-field term arrays, ext challenge."""
-    ch = ext_scalar(challenge)
+def accumulate_ext(acc, term_base, ch):
+    """acc += ch * term for base-field term arrays, ext array scalar ch."""
     t0 = gf.mul(term_base, ch[0])
     t1 = gf.mul(term_base, ch[1])
     if acc is None:
@@ -163,66 +210,102 @@ def accumulate_ext(acc, term_base, challenge):
     return (gf.add(acc[0], t0), gf.add(acc[1], t1))
 
 
-def accumulate_ext_ext(acc, term_ext, challenge):
-    ch = ext_scalar(challenge)
+def accumulate_ext_ext(acc, term_ext, ch):
     t = ext_f.mul(term_ext, ch)
     if acc is None:
         return t
     return ext_f.add(acc, t)
 
 
+def num_gate_sweep_terms(assembly) -> int:
+    return sum(
+        g.num_repetitions(assembly.geometry) * g.num_terms
+        for g in assembly.gates
+        if g.num_terms
+    )
+
+
 def gate_terms_contribution(
     assembly, selector_paths, copy_lde_flat, wit_lde_flat, const_lde_flat,
-    selector_depth, alpha_iter, domain_shape,
+    selector_depth, alpha_pows: AlphaPows, domain_shape,
 ):
-    """Sum over gates/instances/terms of alpha^t * selector_g * term."""
-    geometry = assembly.geometry
-    acc = None
-    for gid, gate in enumerate(assembly.gates):
-        if gate.num_terms == 0:
-            continue
-        path = selector_paths[gid]
-        sel = selector_poly_lde(const_lde_flat, path)
-        reps = gate.num_repetitions(geometry)
-        gate_acc = None
-        for inst in range(reps):
-            row = LdeRowView(
-                copy_lde_flat,
-                wit_lde_flat,
-                const_lde_flat,
-                inst * gate.principal_width,
-                inst * gate.witness_width,
-                selector_depth,
-            )
-            dst = TermsCollector()
-            gate.evaluate(ArrayOps, row, dst)
-            assert len(dst.terms) == gate.num_terms, gate.name
-            for term in dst.terms:
-                gate_acc = accumulate_ext(gate_acc, term, next(alpha_iter))
-        if gate_acc is not None:
-            if sel is not None:
-                gate_acc = (gf.mul(gate_acc[0], sel), gf.mul(gate_acc[1], sel))
-            acc = gate_acc if acc is None else ext_f.add(acc, gate_acc)
-    return acc
+    """Sum over gates/instances/terms of alpha^t * selector_g * term.
+
+    One jitted graph per assembly structure (cached on the assembly object);
+    the trace columns and alpha powers are array arguments.
+    """
+    total = num_gate_sweep_terms(assembly)
+    if total == 0:
+        return None
+    a0, a1 = alpha_pows.take(total)
+    fn = getattr(assembly, "_gate_sweep_jit", None)
+    if fn is None:
+        fn = _build_gate_sweep(
+            tuple(assembly.gates), tuple(tuple(p) for p in selector_paths),
+            assembly.geometry, selector_depth,
+        )
+        assembly._gate_sweep_jit = fn
+    return fn(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1)
 
 
-def aggregate_lookup_columns(cols, table_id_col, gamma, beta):
+def _build_gate_sweep(gates, selector_paths, geometry, selector_depth):
+    def core(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1):
+        t = 0
+        acc = None
+        for gid, gate in enumerate(gates):
+            if gate.num_terms == 0:
+                continue
+            sel = selector_poly_lde(const_lde_flat, selector_paths[gid])
+            reps = gate.num_repetitions(geometry)
+            gate_acc = None
+            for inst in range(reps):
+                row = LdeRowView(
+                    copy_lde_flat,
+                    wit_lde_flat,
+                    const_lde_flat,
+                    inst * gate.principal_width,
+                    inst * gate.witness_width,
+                    selector_depth,
+                )
+                dst = TermsCollector()
+                gate.evaluate(ArrayOps, row, dst)
+                assert len(dst.terms) == gate.num_terms, gate.name
+                for term in dst.terms:
+                    gate_acc = accumulate_ext(gate_acc, term, (a0[t], a1[t]))
+                    t += 1
+            if gate_acc is not None:
+                if sel is not None:
+                    gate_acc = (
+                        gf.mul(gate_acc[0], sel), gf.mul(gate_acc[1], sel)
+                    )
+                acc = gate_acc if acc is None else ext_f.add(acc, gate_acc)
+        return acc
+
+    return jax.jit(core)
+
+
+def _ext_powers_traced(g, count: int):
+    """[1, g, ..., g^(count-1)] as host-loop of traced ext scalar muls."""
+    pows = [(jnp.uint64(1), jnp.uint64(0))]
+    for _ in range(count - 1):
+        pows.append(ext_f.mul(pows[-1], g))
+    return pows
+
+
+def aggregate_lookup_columns(cols, table_id_col, gpow, beta):
     """Σ_j γ^j·col_j (+ γ^w·table_id) + β over whole base arrays -> ext pair.
 
     cols: list of (n,)-or-(N,) base arrays; table_id_col: same-shape base
-    array or None; returns the log-derivative denominator before inversion
+    array or None; gpow: list of ext array scalars [1, γ, γ², …]; beta: ext
+    array scalar. Returns the log-derivative denominator before inversion
     (reference lookup_argument_in_ext.rs:424 'aggregated_lookup_columns').
     """
-    total = len(cols) + (1 if table_id_col is not None else 0)
-    gpow = ext_f.powers_s(gamma, total)
-    b = ext_scalar(beta)
-    acc0 = jnp.broadcast_to(b[0], cols[0].shape)
-    acc1 = jnp.broadcast_to(b[1], cols[0].shape)
+    acc0 = jnp.broadcast_to(beta[0], cols[0].shape)
+    acc1 = jnp.broadcast_to(beta[1], cols[0].shape)
     seq = list(cols) + ([table_id_col] if table_id_col is not None else [])
     for j, col in enumerate(seq):
-        g0, g1 = jnp.uint64(gpow[j][0]), jnp.uint64(gpow[j][1])
-        acc0 = gf.add(acc0, gf.mul(col, g0))
-        acc1 = gf.add(acc1, gf.mul(col, g1))
+        acc0 = gf.add(acc0, gf.mul(col, gpow[j][0]))
+        acc1 = gf.add(acc1, gf.mul(col, gpow[j][1]))
     return (acc0, acc1)
 
 
@@ -239,23 +322,39 @@ def compute_lookup_polys(
       A_i(x) = 1 / (Σ_j γ^j·w_{i,j}(x) + γ^w·table_id(x) + β)
       B(x)   = M(x) / (Σ_j γ^j·t_j(x) + γ^w·t_id(x) + β)
     """
-    a_polys = []
-    for i in range(num_repetitions):
-        cols = [lookup_cols[i * width + j] for j in range(width)]
-        den = aggregate_lookup_columns(cols, table_id_col, lookup_gamma, lookup_beta)
-        a_polys.append(ext_f.batch_inverse(den))
-    t_den = aggregate_lookup_columns(
-        [table_cols[j] for j in range(width)], table_cols[width],
-        lookup_gamma, lookup_beta,
+    b = ext_scalar(lookup_beta)
+    g = ext_scalar(lookup_gamma)
+    dens = _lookup_denominators(
+        lookup_cols, table_id_col, table_cols, b, g,
+        int(num_repetitions), int(width),
     )
-    t_inv = ext_f.batch_inverse(t_den)
+    # invert at top-level jit boundaries (see _chunk_num_den)
+    a_polys = [ext_f.batch_inverse(d) for d in dens[:-1]]
+    t_inv = ext_f.batch_inverse(dens[-1])
     b_poly = (gf.mul(t_inv[0], multiplicities), gf.mul(t_inv[1], multiplicities))
     return a_polys, b_poly
 
 
+@partial(jax.jit, static_argnums=(5, 6))
+def _lookup_denominators(
+    lookup_cols, table_id_col, table_cols, b, g, num_repetitions, width
+):
+    gpow = _ext_powers_traced(g, width + 1)
+    dens = []
+    for i in range(num_repetitions):
+        cols = [lookup_cols[i * width + j] for j in range(width)]
+        dens.append(aggregate_lookup_columns(cols, table_id_col, gpow, b))
+    dens.append(
+        aggregate_lookup_columns(
+            [table_cols[j] for j in range(width)], table_cols[width], gpow, b
+        )
+    )
+    return dens
+
+
 def lookup_quotient_terms(
     a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
-    lookup_beta, lookup_gamma, num_repetitions, width, alpha_iter,
+    lookup_beta, lookup_gamma, num_repetitions, width, alpha_pows: AlphaPows,
 ):
     """Quotient contributions over the LDE domain (reference
     compute_quotient_terms_for_lookup_specialized,
@@ -264,27 +363,42 @@ def lookup_quotient_terms(
       per sub-arg i: A_i(x)·(Σ γ^j·w_{i,j}(x) + γ^w·tid(x) + β) − 1
       for B:         B(x)·(Σ γ^j·t_j(x) + γ^w·t_id(x) + β) − M(x)
     """
+    a0, a1 = alpha_pows.take(num_repetitions + 1)
+    return _lookup_quotient_core(
+        a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
+        ext_scalar(lookup_beta), ext_scalar(lookup_gamma), a0, a1,
+        int(num_repetitions), int(width),
+    )
+
+
+@partial(jax.jit, static_argnums=(10, 11))
+def _lookup_quotient_core(
+    a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
+    b, g, a0, a1, num_repetitions, width,
+):
+    gpow = _ext_powers_traced(g, width + 1)
     acc = None
     one = jnp.uint64(1)
     for i in range(num_repetitions):
         cols = [lookup_lde_cols[i * width + j] for j in range(width)]
-        den = aggregate_lookup_columns(cols, table_id_lde, lookup_gamma, lookup_beta)
+        den = aggregate_lookup_columns(cols, table_id_lde, gpow, b)
         term = ext_f.mul(a_ldes[i], den)
         term = (gf.sub(term[0], jnp.broadcast_to(one, term[0].shape)), term[1])
-        acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+        acc = accumulate_ext_ext(acc, term, (a0[i], a1[i]))
     t_den = aggregate_lookup_columns(
-        [table_ldes[j] for j in range(width)], table_ldes[width],
-        lookup_gamma, lookup_beta,
+        [table_ldes[j] for j in range(width)], table_ldes[width], gpow, b
     )
     term = ext_f.mul(b_lde, t_den)
     term = (gf.sub(term[0], mult_lde), term[1])
-    acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+    acc = accumulate_ext_ext(
+        acc, term, (a0[num_repetitions], a1[num_repetitions])
+    )
     return acc
 
 
 def copy_permutation_quotient_terms(
     z_lde, z_shift_lde, partial_ldes, chunks, copy_lde, sigma_lde,
-    non_residues, xs_lde, l0_lde, beta, gamma, alpha_iter,
+    non_residues, xs_lde, l0_lde, beta, gamma, alpha_pows: AlphaPows,
 ):
     """Quotient contributions of the copy-permutation argument over the LDE
     domain (reference copy_permutation.rs:1000):
@@ -293,16 +407,28 @@ def copy_permutation_quotient_terms(
       per chunk j:  lhs_j(x)·prod_den_j(x) − rhs_j(x)·prod_num_j(x)
         where (lhs, rhs) walk z, p_0, …, p_last, z(w·x).
     """
-    b = ext_scalar(beta)
-    g = ext_scalar(gamma)
+    a0, a1 = alpha_pows.take(1 + len(chunks))
+    return _cp_quotient_core(
+        z_lde, z_shift_lde, partial_ldes, copy_lde, sigma_lde, xs_lde,
+        l0_lde, ext_scalar(beta), ext_scalar(gamma), a0, a1,
+        tuple(tuple(c) for c in chunks),
+        tuple(int(k) for k in non_residues),
+    )
+
+
+@partial(jax.jit, static_argnums=(11, 12))
+def _cp_quotient_core(
+    z_lde, z_shift_lde, partial_ldes, copy_lde, sigma_lde, xs_lde, l0_lde,
+    b, g, a0, a1, chunks, non_residues,
+):
     one = jnp.uint64(1)
     acc = None
     # L_0(x)(z(x)-1)
     zm1 = (gf.sub(z_lde[0], jnp.broadcast_to(one, z_lde[0].shape)), z_lde[1])
     t0 = (gf.mul(zm1[0], l0_lde), gf.mul(zm1[1], l0_lde))
-    acc = accumulate_ext_ext(acc, t0, next(alpha_iter))
-    lhs_seq = partial_ldes + [z_shift_lde]
-    rhs_seq = [z_lde] + partial_ldes
+    acc = accumulate_ext_ext(acc, t0, (a0[0], a1[0]))
+    lhs_seq = list(partial_ldes) + [z_shift_lde]
+    rhs_seq = [z_lde] + list(partial_ldes)
     ks = non_residues
     for j, chunk in enumerate(chunks):
         num_p = None
@@ -324,5 +450,5 @@ def copy_permutation_quotient_terms(
         term = ext_f.sub(
             ext_f.mul(lhs_seq[j], den_p), ext_f.mul(rhs_seq[j], num_p)
         )
-        acc = accumulate_ext_ext(acc, term, next(alpha_iter))
+        acc = accumulate_ext_ext(acc, term, (a0[1 + j], a1[1 + j]))
     return acc

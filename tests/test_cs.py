@@ -93,7 +93,10 @@ def test_unsatisfied_detected():
     b = cs.alloc_variable_with_value(5)
     c = cs.alloc_variable_with_value(7)
     d = FmaGate.fma(cs, a, b, c)
-    # corrupt the witness after the fact
+    # corrupt the witness after the fact (read first: with the native tape
+    # engine the value materializes lazily, and an unflushed write would be
+    # overwritten by the flush)
+    assert cs.get_value(d) == (3 * 5 + 7)
     cs.resolver.values[d] = 999
     asm = cs.into_assembly()
     assert not check_if_satisfied(asm)
@@ -124,3 +127,53 @@ def test_row_amortization():
     assert fma_rows == 1
     asm = cs.into_assembly()
     assert check_if_satisfied(asm)
+
+
+def test_ext_fma_gate():
+    import random
+
+    from boojum_tpu.cs.gates.ext_fma import ExtFmaGate
+    from boojum_tpu.field import extension as ext_host
+
+    geom = CSGeometry(16, 0, 6, 4)
+    cs = ConstraintSystem(geom, 64)
+    rng = random.Random(3)
+    a = tuple(cs.alloc_variable_with_value(rng.randrange(gl.P)) for _ in range(2))
+    b = tuple(cs.alloc_variable_with_value(rng.randrange(gl.P)) for _ in range(2))
+    c = tuple(cs.alloc_variable_with_value(rng.randrange(gl.P)) for _ in range(2))
+    d = ExtFmaGate.fma(cs, a, b, c, coeff_ab=(2, 3), coeff_c=(5, 7))
+    av = (cs.get_value(a[0]), cs.get_value(a[1]))
+    bv = (cs.get_value(b[0]), cs.get_value(b[1]))
+    cv = (cs.get_value(c[0]), cs.get_value(c[1]))
+    expect = ext_host.add_s(
+        ext_host.mul_s(ext_host.mul_s((2, 3), av), bv),
+        ext_host.mul_s((5, 7), cv),
+    )
+    assert (cs.get_value(d[0]), cs.get_value(d[1])) == tuple(expect)
+    iv = ExtFmaGate.inversion(cs, a)
+    assert ext_host.mul_s(av, (cs.get_value(iv[0]), cs.get_value(iv[1]))) == (1, 0)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm, verbose=True)
+    # tamper
+    asm.copy_cols_values[6, 0] = (int(asm.copy_cols_values[6, 0]) + 1) % gl.P
+    assert not check_if_satisfied(asm)
+
+
+def test_native_flush_with_far_waiter():
+    """A python closure parked on a place beyond the arena capacity must not
+    crash the native tape flush (regression: unguarded resolved[p] index)."""
+    from boojum_tpu.dag import make_resolver
+
+    r = make_resolver(capacity=16)
+    out = 2
+    r.add_resolution([100000], [out], lambda v: [v[0] + 1])
+    r.set_value(0, 7)  # benign
+    # native op -> tape; flush via get_value must not IndexError
+    from boojum_tpu.native import OP_CONST, get_lib
+
+    if get_lib() is None:
+        return
+    r.add_resolution([], [1], lambda _: [5], native=(OP_CONST, (5,)))
+    assert r.get_value(1) == 5
+    r.set_value(100000, 9)
+    assert r.get_value(out) == 10

