@@ -1,0 +1,177 @@
+"""Symbolic gate-program capture — the offload-synthesizer seam.
+
+Counterpart of `/root/reference/src/gpu_synthesizer/` (856 LoC):
+`GpuSynthesizerFieldLike` (mod.rs:201) runs each gate's constraint evaluator
+once over a fake field whose "values" are symbolic indices, recording every
+arithmetic op as a `Relation` (mod.rs:169-190) so a device backend can replay
+constraint evaluation without re-tracing the evaluator.
+
+Here the same contract face (`zero/one/constant/add/sub/mul/neg/double`)
+records a straight-line SSA program per gate. Two uses:
+- inspection/debug: a portable, serializable description of every gate's
+  constraint circuit (op counts, degree audits);
+- replay: `GateProgram.evaluate_rows` interprets the program over any ops
+  context (scalars or whole device arrays), byte-equivalent to running the
+  evaluator directly — this is the seam a custom fused-kernel backend
+  (e.g. a Pallas gate-sweep generator) consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..field import gl
+from .gates.base import RowView, TermsCollector
+
+
+@dataclass(frozen=True)
+class Sym:
+    """A symbolic value: an SSA slot index."""
+
+    idx: int
+
+
+@dataclass
+class GateProgram:
+    """Straight-line program of one gate instance's constraint evaluation.
+
+    Inputs are addressed as ('v', i) / ('w', i) / ('c', i) loads; every op is
+    (opcode, dst_slot, src_a, src_b) with constants inlined by value.
+    """
+
+    gate_name: str = ""
+    loads: list = field(default_factory=list)  # (slot, kind, index)
+    consts: list = field(default_factory=list)  # (slot, value)
+    ops: list = field(default_factory=list)  # (op, dst, a_slot, b_slot)
+    terms: list = field(default_factory=list)  # slot per quotient term
+    num_slots: int = 0
+
+    # -- replay ------------------------------------------------------------
+
+    def evaluate(self, ops_ctx, row: RowView):
+        """Interpret over any field-like ops context + row view; returns the
+        term values (same results as gate.evaluate, by construction)."""
+        slots = [None] * self.num_slots
+        for slot, kind, index in self.loads:
+            slots[slot] = (
+                row.v(index) if kind == "v"
+                else row.w(index) if kind == "w"
+                else row.c(index)
+            )
+        for slot, value in self.consts:
+            slots[slot] = ops_ctx.constant(value)
+        for op, dst, a, b in self.ops:
+            if op == "add":
+                slots[dst] = ops_ctx.add(slots[a], slots[b])
+            elif op == "sub":
+                slots[dst] = ops_ctx.sub(slots[a], slots[b])
+            elif op == "mul":
+                slots[dst] = ops_ctx.mul(slots[a], slots[b])
+            elif op == "neg":
+                slots[dst] = ops_ctx.neg(slots[a])
+            elif op == "double":
+                slots[dst] = ops_ctx.double(slots[a])
+            else:
+                raise ValueError(op)
+        return [slots[t] for t in self.terms]
+
+    def stats(self) -> dict:
+        from collections import Counter
+
+        c = Counter(op for (op, *_rest) in self.ops)
+        return {
+            "gate": self.gate_name,
+            "loads": len(self.loads),
+            "constants": len(self.consts),
+            **dict(c),
+            "terms": len(self.terms),
+        }
+
+
+class _CaptureOps:
+    """The symbolic field-like ops face (GpuSynthesizerFieldLike analogue)."""
+
+    def __init__(self, program: GateProgram):
+        self.p = program
+
+    def _new(self) -> int:
+        s = self.p.num_slots
+        self.p.num_slots += 1
+        return s
+
+    def zero(self):
+        return self.constant(0)
+
+    def one(self):
+        return self.constant(1)
+
+    def constant(self, v: int):
+        s = self._new()
+        self.p.consts.append((s, int(v) % gl.P))
+        return Sym(s)
+
+    def _bin(self, op, a: Sym, b: Sym):
+        s = self._new()
+        self.p.ops.append((op, s, a.idx, b.idx))
+        return Sym(s)
+
+    def add(self, a, b):
+        return self._bin("add", a, b)
+
+    def sub(self, a, b):
+        return self._bin("sub", a, b)
+
+    def mul(self, a, b):
+        return self._bin("mul", a, b)
+
+    def neg(self, a):
+        s = self._new()
+        self.p.ops.append(("neg", s, a.idx, a.idx))
+        return Sym(s)
+
+    def double(self, a):
+        s = self._new()
+        self.p.ops.append(("double", s, a.idx, a.idx))
+        return Sym(s)
+
+
+def capture_gate_program(gate, constants=()) -> GateProgram:
+    """Run the gate's evaluator once over symbolic values, recording its
+    constraint program (reference GPUDataCapture::from_evaluator,
+    gpu_synthesizer/mod.rs:354)."""
+    p = GateProgram(gate_name=gate.name)
+    ops = _CaptureOps(p)
+
+    def load(kind):
+        def get(i):
+            s = ops._new()
+            p.loads.append((s, kind, i))
+            return Sym(s)
+
+        return get
+
+    # memoize loads so repeated row.v(i) maps to one slot
+    cache: dict = {}
+
+    def memo(kind):
+        raw = load(kind)
+
+        def get(i):
+            key = (kind, i)
+            if key not in cache:
+                cache[key] = raw(i)
+            return cache[key]
+
+        return get
+
+    row = RowView(memo("v"), memo("w"), memo("c"))
+    dst = TermsCollector()
+    gate.evaluate(ops, row, dst)
+    p.terms = [t.idx for t in dst.terms]
+    return p
+
+
+def capture_all(gates, constants_by_gate=None) -> dict:
+    """Programs for a whole gate set (reference GatesSetForGPU,
+    gpu_synthesizer/mod.rs:446)."""
+    return {g.name: capture_gate_program(g) for g in gates}

@@ -1,0 +1,108 @@
+"""Composite-gadget derivation: allocate / select / witness hooks.
+
+Counterpart of the reference's `cs_derive` proc-macro crate (937 LoC:
+`CSAllocatable`, `CSSelectable`, `WitnessHookable`,
+`CSVarLengthEncodable` derives) and the gadget traits they implement
+(`/root/reference/src/gadgets/traits/allocatable.rs:6`, `selectable.rs:8`,
+`witnessable.rs:121`). Rust needs compile-time codegen for this; in python a
+small structural recursion over dataclass fields does the same job at
+runtime:
+
+    @derive_gadget
+    @dataclass
+    class Point:
+        x: Num
+        y: Num
+
+    p = Point.allocate(cs, {"x": 3, "y": 4})
+    q = Point.select(cs, flag, p, r)
+    hook = Point.witness_hook(cs, p); hook() -> {"x": 3, "y": 4}
+
+Any field whose type provides `allocate`/`select`/`get_value` composes,
+including nested derived gadgets, lists, and tuples.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .boolean import Boolean
+from .num import Num
+
+
+def _allocate_value(cls, cs, witness):
+    if dataclasses.is_dataclass(cls) and hasattr(cls, "allocate"):
+        return cls.allocate(cs, witness)
+    if hasattr(cls, "allocate_checked"):
+        return cls.allocate_checked(cs, witness)
+    if hasattr(cls, "allocate"):
+        return cls.allocate(cs, witness)
+    raise TypeError(f"field type {cls} is not allocatable")
+
+
+def _select_value(cs, flag, a, b):
+    if type(a) is not type(b):
+        raise TypeError("select over mismatched types")
+    if isinstance(a, (list, tuple)):
+        out = [ _select_value(cs, flag, x, y) for x, y in zip(a, b) ]
+        return type(a)(out)
+    t = type(a)
+    if hasattr(t, "select"):
+        return t.select(cs, flag, a, b)
+    raise TypeError(f"{t} is not selectable")
+
+
+def _witness_value(cs, v):
+    if isinstance(v, (list, tuple)):
+        return type(v)(_witness_value(cs, x) for x in v)
+    if dataclasses.is_dataclass(v) and hasattr(type(v), "witness_hook"):
+        return type(v).witness_hook(cs, v)()
+    if hasattr(v, "get_value"):
+        return v.get_value(cs)
+    raise TypeError(f"{type(v)} is not witnessable")
+
+
+def derive_gadget(cls):
+    """Class decorator adding allocate / select / witness_hook to a
+    dataclass of gadget fields (the runtime face of the reference's
+    #[derive(CSAllocatable, CSSelectable, WitnessHookable)])."""
+    assert dataclasses.is_dataclass(cls), "derive_gadget expects a dataclass"
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    fields = dataclasses.fields(cls)
+
+    def allocate(cs, witness: dict):
+        kwargs = {}
+        for f in fields:
+            kwargs[f.name] = _allocate_value(hints[f.name], cs, witness[f.name])
+        return cls(**kwargs)
+
+    def select(cs, flag: Boolean, a, b):
+        kwargs = {
+            f.name: _select_value(cs, flag, getattr(a, f.name), getattr(b, f.name))
+            for f in fields
+        }
+        return cls(**kwargs)
+
+    def witness_hook(cs, value):
+        """Deferred witness getter (reference WitnessHookable): call the
+        returned closure after synthesis to materialize the values."""
+
+        def hook():
+            return {
+                f.name: _witness_value(cs, getattr(value, f.name))
+                for f in fields
+            }
+
+        return hook
+
+    cls.allocate = staticmethod(allocate)
+    cls.select = staticmethod(select)
+    cls.witness_hook = staticmethod(witness_hook)
+    return cls
+
+
+# Make the scalar gadgets compose: Num/Boolean already provide
+# allocate/select/get_value with the right shapes.
+__all__ = ["derive_gadget"]

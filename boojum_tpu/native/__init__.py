@@ -166,13 +166,13 @@ class NativeTape:
             _as_i64p(ins), _as_i64p(i_off),
             _as_i64p(outs), _as_i64p(o_off),
         )
-        if rc != 0:
-            op = -int(rc) - 1
-            raise RuntimeError(
-                f"native resolver op {op} (kind {self.kinds[op]}) failed — "
-                "lookup miss or unregistered table"
-            )
+        # clear the tape BEFORE acting on the result: a failed batch must
+        # never be re-executed (ops before the failure already ran — a
+        # second pass would double-bump lookup multiplicities)
         out_places = self.outs
+        failed_kind = None
+        if rc != 0:
+            failed_kind = self.kinds[-int(rc) - 1]
         self.kinds = []
         self.params = []
         self.param_off = [0]
@@ -180,6 +180,11 @@ class NativeTape:
         self.in_off = [0]
         self.outs = []
         self.out_off = [0]
+        if rc != 0:
+            raise RuntimeError(
+                f"native resolver op (kind {failed_kind}) failed — "
+                "lookup miss, oversized key, or unregistered table"
+            )
         return out_places
 
     def multiplicities(self, table_id: int) -> np.ndarray:

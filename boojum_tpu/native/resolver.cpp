@@ -83,6 +83,8 @@ static inline u64 key_hash(const u64 *key, int num_keys) {
 extern "C" int register_table(i64 table_id, const u64 *content, i64 rows,
                               int width, int num_keys) {
   if (table_id < 1) return -1;
+  if (num_keys < 0 || num_keys > 8 || width < num_keys || width > 16)
+    return -2; // key buffer in the op interpreter is u64[8]
   if ((i64)g_tables.size() < table_id) g_tables.resize(table_id);
   Table &t = g_tables[table_id - 1];
   t.width = width;
@@ -319,6 +321,7 @@ extern "C" i64 execute_tape(
         i64 tid = (i64)pp[0];
         if (tid < 1 || tid > (i64)g_tables.size()) return -(op + 1);
         Table &t = g_tables[tid - 1];
+        if (n_in > 8) return -(op + 1);
         u64 key[8];
         for (i64 j = 0; j < n_in; j++) key[j] = values[ins[j]];
         i64 r = table_find(t, key);
@@ -331,6 +334,7 @@ extern "C" i64 execute_tape(
         i64 tid = (i64)pp[0];
         if (tid < 1 || tid > (i64)g_tables.size()) return -(op + 1);
         Table &t = g_tables[tid - 1];
+        if (t.num_keys > 8 || (i64)t.num_keys > n_in) return -(op + 1);
         u64 key[8];
         for (int j = 0; j < t.num_keys; j++) key[j] = values[ins[j]];
         i64 r = table_find(t, key);

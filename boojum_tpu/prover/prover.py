@@ -46,6 +46,26 @@ from .config import ProofConfig
 from .fri import fri_prove
 from .pow import pow_grind
 from .proof import OracleQuery, Proof, SingleRoundQueries
+from ..utils import stage_timer
+
+
+class _StageClock:
+    """Sequential stage timing with guaranteed cleanup: prove() wraps its
+    body in try/finally so an exception mid-stage still closes the open
+    stage_timer (incl. any jax.profiler annotation)."""
+
+    def __init__(self):
+        self._cm = None
+
+    def start(self, name):
+        self.stop()
+        self._cm = stage_timer(name)
+        self._cm.__enter__()
+
+    def stop(self):
+        if self._cm is not None:
+            self._cm.__exit__(None, None, None)
+            self._cm = None
 from .stages import (
     AlphaPows,
     compute_copy_permutation_stage2,
@@ -107,6 +127,14 @@ def _vanishing_inv_brev(log_n, lde_factor):
 
 
 def prove(assembly, setup, config: ProofConfig) -> Proof:
+    clock = _StageClock()
+    try:
+        return _prove_impl(assembly, setup, config, clock)
+    finally:
+        clock.stop()
+
+
+def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     n = assembly.trace_len
     log_n = n.bit_length() - 1
     L = config.fri_lde_factor
@@ -130,6 +158,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     t.witness_field_elements(pi_values)
 
     # ---- round 1: witness commitment -------------------------------------
+    clock.start("round1_witness_commit")
     copy_vals = jnp.asarray(assembly.copy_cols_values)
     cols = [copy_vals]
     if LC:
@@ -153,6 +182,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
         lookup_gamma = t.get_ext_challenge()
 
     # ---- round 2: copy-permutation + lookup stage 2 ----------------------
+    clock.start("round2_stage2_commit")
     sigma_dev = jnp.asarray(setup.sigma_cols)
     z, partials, chunks = compute_copy_permutation_stage2(
         copy_vals, sigma_dev, setup.non_residues, beta, gamma,
@@ -179,6 +209,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     alpha = t.get_ext_challenge()
 
     # ---- round 3: quotient -----------------------------------------------
+    clock.start("round3_quotient")
     wit_lde_all = wit_lde.reshape(Ct + W + M, N)
     copy_lde_flat = wit_lde_all[:Ct]
     gate_wit_lde = wit_lde_all[Ct : Ct + W] if W else None
@@ -280,6 +311,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     z_chal = t.get_ext_challenge()
 
     # ---- round 4: evaluations at z (and z*omega, 0) ----------------------
+    clock.start("round4_evaluations")
     all_mono = jnp.concatenate([wit_mono, setup.setup_monomials, s2_mono, q_mono])
     B = all_mono.shape[0]
     z_pows = ext_powers_device(z_chal, n)
@@ -313,6 +345,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     deep_ch = t.get_ext_challenge()
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
+    clock.start("round5_deep_fri")
     all_lde_flat = jnp.concatenate(
         [
             wit_lde_all,
@@ -385,6 +418,7 @@ def prove(assembly, setup, config: ProofConfig) -> Proof:
     pow_nonce = pow_grind(t, config.pow_bits)
 
     # ---- queries ----------------------------------------------------------
+    clock.start("queries")
     bs = BitSource(log_full)
     q_leaves = q_lde.reshape(2 * L, N)
     queries = []

@@ -1,0 +1,3 @@
+from .profiling import stage_timer, profiling_enabled, log
+
+__all__ = ["stage_timer", "profiling_enabled", "log"]

@@ -1,0 +1,55 @@
+"""Lightweight tracing/profiling.
+
+Counterpart of the reference's observability layer (SURVEY.md §5): the
+`firestorm` scoped profiling macros (`profile_fn!/profile_section!`,
+reference src/lib.rs:80, used throughout prover.rs) and the `log!` macro
+(src/log_utils.rs). Here: a `stage_timer` context manager emitting per-stage
+wall-clock lines, enabled by BOOJUM_TPU_PROFILE=1 (or programmatically), and
+a `log` helper gated the same way. TPU-side kernel profiles come from
+`jax.profiler` traces (set BOOJUM_TPU_JAX_TRACE=<dir> around a prove call).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+
+_FORCED: bool | None = None
+
+
+def profiling_enabled() -> bool:
+    if _FORCED is not None:
+        return _FORCED
+    return bool(os.environ.get("BOOJUM_TPU_PROFILE"))
+
+
+def set_profiling(on: bool | None):
+    """Programmatic override (None = follow the environment)."""
+    global _FORCED
+    _FORCED = on
+
+
+def log(msg: str):
+    if profiling_enabled():
+        print(f"[boojum_tpu] {msg}", file=sys.stderr, flush=True)
+
+
+@contextlib.contextmanager
+def stage_timer(name: str):
+    """Wall-clock a prover stage; also opens a jax.profiler trace context
+    when BOOJUM_TPU_JAX_TRACE points at a directory."""
+    trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
+    if not profiling_enabled() and not trace_dir:
+        yield
+        return
+    ctx = contextlib.nullcontext()
+    if trace_dir:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    t0 = time.perf_counter()
+    with ctx:
+        yield
+    log(f"{name}: {time.perf_counter() - t0:.3f}s")

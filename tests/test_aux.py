@@ -1,0 +1,117 @@
+"""Aux subsystem tests: gate-program capture (offload seam), external-witness
+repeated proving, profiling timers (reference test model: gpu_synthesizer +
+witness.rs + observability, SURVEY.md §5)."""
+
+import io
+import sys
+
+import numpy as np
+
+from boojum_tpu.cs.gate_capture import capture_all, capture_gate_program
+from boojum_tpu.cs.field_like import ScalarOps
+from boojum_tpu.cs.gates import FmaGate, Poseidon2FlattenedGate, ReductionGate
+from boojum_tpu.cs.gates.base import RowView, TermsCollector
+from boojum_tpu.field import gl
+
+
+def _row(vals, consts):
+    return RowView(
+        lambda i: vals[i], lambda i: 0,
+        lambda i: consts[i] if i < len(consts) else 0,
+    )
+
+
+def test_capture_replay_matches_direct_eval():
+    import random
+
+    rng = random.Random(5)
+    for gate, width, consts in (
+        (FmaGate.instance(), 4, (3, 7)),
+        (ReductionGate.instance(), 5, (1, 2, 3, 4)),
+        (Poseidon2FlattenedGate.instance(), 130, ()),
+    ):
+        prog = capture_gate_program(gate)
+        vals = [rng.randrange(gl.P) for _ in range(width)]
+        row = _row(vals, consts)
+        direct = TermsCollector()
+        gate.evaluate(ScalarOps, row, direct)
+        replayed = prog.evaluate(ScalarOps, row)
+        assert replayed == direct.terms, gate.name
+        stats = prog.stats()
+        assert stats["terms"] == gate.num_terms
+
+
+def test_capture_all_gate_set():
+    progs = capture_all([FmaGate.instance(), ReductionGate.instance()])
+    assert set(progs) == {"fma", "reduction4"}
+
+
+def test_external_witness_reprove():
+    from test_e2e import CONFIG, build_fibonacci_circuit
+    from boojum_tpu.prover import generate_setup, prove, verify
+
+    cs, _ = build_fibonacci_circuit(steps=5)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    wv = asm.witness_vec()
+    asm2 = asm.with_external_witness(wv)
+    proof = prove(asm2, setup, CONFIG)
+    assert verify(setup.vk, proof, asm.gates)
+    # identical witness -> identical proof
+    assert proof.to_json() == prove(asm, setup, CONFIG).to_json()
+
+
+def test_stage_timers_emit():
+    from boojum_tpu.utils import profiling
+
+    profiling.set_profiling(True)
+    try:
+        err = io.StringIO()
+        old = sys.stderr
+        sys.stderr = err
+        try:
+            with profiling.stage_timer("unit_test_stage"):
+                pass
+        finally:
+            sys.stderr = old
+        assert "unit_test_stage" in err.getvalue()
+    finally:
+        profiling.set_profiling(None)
+
+
+def test_derive_gadget():
+    from dataclasses import dataclass
+
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.gadgets.boolean import Boolean
+    from boojum_tpu.gadgets.derive import derive_gadget
+    from boojum_tpu.gadgets.num import Num
+
+    @derive_gadget
+    @dataclass
+    class Point:
+        x: Num
+        y: Num
+
+    @derive_gadget
+    @dataclass
+    class Flagged:
+        p: Point
+        ok: Boolean
+
+    cs = ConstraintSystem(CSGeometry(16, 0, 6, 4), 256)
+    a = Flagged.allocate(cs, {"p": {"x": 3, "y": 4}, "ok": True})
+    b = Flagged.allocate(cs, {"p": {"x": 30, "y": 40}, "ok": False})
+    flag = Boolean.allocate(cs, True)
+    sel = Flagged.select(cs, flag, a, b)
+    hook = Flagged.witness_hook(cs, sel)
+    assert hook() == {"p": {"x": 3, "y": 4}, "ok": True}
+    flag2 = Boolean.allocate(cs, False)
+    sel2 = Flagged.select(cs, flag2, a, b)
+    assert Flagged.witness_hook(cs, sel2)() == {
+        "p": {"x": 30, "y": 40}, "ok": False,
+    }
+    from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+    assert check_if_satisfied(cs.into_assembly())
