@@ -12,6 +12,7 @@ gathers from the stored device layers on host at query time (queries are rare:
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -60,15 +61,31 @@ class MerkleTreeWithCap:
     def get_cap(self):
         return list(self._cap_host)
 
-    def get_proof(self, leaf_idx: int):
-        """Sibling digests from the leaf layer up to (not including) the cap."""
-        path = []
-        idx = leaf_idx
+    def get_proofs(self, leaf_indices):
+        """Batched path extraction for many queries: ONE device gather per
+        tree level (a (num_queries, 4) slice) instead of per-query
+        per-level element reads — behind a network tunnel the round-trips
+        dominate, on local hardware it is still fewer, larger transfers.
+        Returns a list of paths aligned with leaf_indices."""
+        idxs = np.array(list(leaf_indices), dtype=np.int64)
+        # sibling indices per level are host-computable up front: dispatch
+        # every gather asynchronously, block once at the end
+        pending = []
+        cur = idxs
         for layer in self.layers[:-1]:
-            sib = np.asarray(layer[idx ^ 1])
-            path.append(tuple(int(x) for x in sib))
-            idx >>= 1
-        return path
+            pending.append(layer[jnp.asarray(cur ^ 1)])  # (Q, 4) lazy
+            cur = cur >> 1
+        levels = [np.asarray(x) for x in jax.device_get(pending)]
+        paths = []
+        for q in range(len(idxs)):
+            paths.append(
+                [tuple(int(x) for x in level[q]) for level in levels]
+            )
+        return paths
+
+    def get_proof(self, leaf_idx: int):
+        """Single-query path (see get_proofs for the batched form)."""
+        return self.get_proofs([leaf_idx])[0]
 
 
 def verify_proof_over_cap(leaf_values, path, cap, leaf_idx: int) -> bool:

@@ -420,35 +420,62 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # ---- queries ----------------------------------------------------------
     clock.start("queries")
     bs = BitSource(log_full)
-    q_leaves = q_lde.reshape(2 * L, N)
-    queries = []
-    for _ in range(config.num_queries):
-        idx = bs.get_index(t, log_full)
-        def oq(leaves_cols, tree, leaf_idx):
-            vals = [int(x) for x in np.asarray(leaves_cols[:, leaf_idx])]
-            return OracleQuery(leaf_values=vals, path=tree.get_proof(leaf_idx))
-        fri_qs = []
-        fidx = idx
-        for r, tree in enumerate(fri.trees):
-            pair = fidx >> 1
-            v = fri.values[r]
-            vals = [
-                int(np.asarray(v[0][2 * pair])),
-                int(np.asarray(v[1][2 * pair])),
-                int(np.asarray(v[0][2 * pair + 1])),
-                int(np.asarray(v[1][2 * pair + 1])),
-            ]
-            fri_qs.append(OracleQuery(leaf_values=vals, path=tree.get_proof(pair)))
-            fidx >>= 1
-        queries.append(
-            SingleRoundQueries(
-                witness=oq(wit_lde_all, wit_tree, idx),
-                stage2=oq(s2_lde_flat, s2_tree, idx),
-                quotient=oq(q_leaves, q_tree, idx),
-                setup=oq(setup_lde_flat, setup.setup_tree, idx),
-                fri=fri_qs,
+    # draw ALL query indices first (same transcript sequence the verifier
+    # replays), then extract every oracle batched: one device gather per
+    # storage / per tree level instead of per-query element reads — the
+    # round-trips dominate when the device sits behind a network tunnel
+    idxs = [bs.get_index(t, log_full) for _ in range(config.num_queries)]
+    idx_dev = jnp.asarray(np.array(idxs, dtype=np.int64))
+
+    def oracle_queries(leaves_cols, tree):
+        vals = np.asarray(leaves_cols[:, idx_dev])  # (B, Q) one gather
+        paths = tree.get_proofs(idxs)
+        return [
+            OracleQuery(
+                leaf_values=[int(x) for x in vals[:, q]], path=paths[q]
             )
+            for q in range(len(idxs))
+        ]
+
+    wit_qs = oracle_queries(wit_lde_all, wit_tree)
+    s2_qs = oracle_queries(s2_lde_flat, s2_tree)
+    q_qs = oracle_queries(q_lde.reshape(2 * L, N), q_tree)
+    setup_qs = oracle_queries(setup_lde_flat, setup.setup_tree)
+    fri_qs_per_round = []
+    fidxs = np.array(idxs, dtype=np.int64)
+    for r, tree in enumerate(fri.trees):
+        pairs = fidxs >> 1
+        v0, v1 = fri.values[r]
+        # one gather for the round: rows = [ev0, od0, ev1, od1] stacked
+        pair_dev = jnp.asarray(np.concatenate([2 * pairs, 2 * pairs + 1]))
+        gathered = np.asarray(
+            jnp.stack([v0[pair_dev], v1[pair_dev]])
+        )  # (2, 2Q): [c0|c1] x [evens|odds]
+        Q = len(idxs)
+        paths = tree.get_proofs([int(p) for p in pairs])
+        fri_qs_per_round.append(
+            [
+                OracleQuery(
+                    leaf_values=[
+                        int(gathered[0, q]), int(gathered[1, q]),
+                        int(gathered[0, Q + q]), int(gathered[1, Q + q]),
+                    ],
+                    path=paths[q],
+                )
+                for q in range(Q)
+            ]
         )
+        fidxs = pairs
+    queries = [
+        SingleRoundQueries(
+            witness=wit_qs[q],
+            stage2=s2_qs[q],
+            quotient=q_qs[q],
+            setup=setup_qs[q],
+            fri=[fri_qs_per_round[r][q] for r in range(len(fri.trees))],
+        )
+        for q in range(len(idxs))
+    ]
 
     return Proof(
         public_inputs=pi_values,
