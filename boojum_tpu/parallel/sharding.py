@@ -31,6 +31,82 @@ from ..hashes.poseidon2 import leaf_hash, node_hash
 from ..ntt import lde_from_monomial, monomial_from_values, powers_device
 
 
+_ACTIVE_MESH: list = [None]
+
+
+def active_mesh() -> Mesh | None:
+    """The mesh the prover is currently sharding over (None = single chip)."""
+    return _ACTIVE_MESH[0]
+
+
+class prover_mesh:
+    """Context manager activating a device mesh for a full `prove()` run.
+
+    Inside the context the prover device-puts its polynomial-batch inputs
+    column-sharded and pivots Merkle leaves to row sharding; every jitted
+    stage then auto-partitions from its operand shardings (GSPMD inserts
+    the collectives). All field ops are exact integer ops with a fixed
+    reduction structure, so the sharded proof is byte-identical to the
+    single-device proof.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        self._prev = _ACTIVE_MESH[0]
+        _ACTIVE_MESH[0] = self.mesh
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _ACTIVE_MESH[0] = self._prev
+        return False
+
+
+def shard_cols(arr):
+    """Column-shard a (C, ...) polynomial batch over the active mesh (no-op
+    when no mesh is active). Column counts are arbitrary (e.g. 15 oracle
+    columns over a 4-way axis), and NamedSharding demands divisibility, so
+    when 'col' does not divide the batch axis the (power-of-two) domain axis
+    is sharded instead — the row axis always divides it."""
+    m = active_mesh()
+    if m is None:
+        return arr
+    ncol, nrow = m.shape["col"], m.shape["row"]
+    nd = arr.ndim
+    if arr.shape[0] % ncol == 0:
+        spec = P("col", *([None] * (nd - 1)))
+    elif arr.shape[-1] % (ncol * nrow) == 0:
+        spec = P(*([None] * (nd - 1)), ("col", "row"))
+    elif arr.shape[-1] % nrow == 0:
+        spec = P(*([None] * (nd - 1)), "row")
+    else:
+        return arr
+    return jax.device_put(arr, NamedSharding(m, spec))
+
+
+def shard_leaves(arr):
+    """Row-shard a (num_leaves, width) leaf batch over BOTH mesh axes (the
+    col->row layout pivot before Merkle leaf hashing). Falls back to the
+    largest mesh axis dividing the (power-of-two) leaf count on non-pow2
+    meshes, and to no sharding when nothing divides."""
+    m = active_mesh()
+    if m is None:
+        return arr
+    n = arr.shape[0]
+    ncol, nrow = m.shape["col"], m.shape["row"]
+    if n % (ncol * nrow) == 0:
+        axes = ("col", "row")
+    elif n % ncol == 0:
+        axes = ("col",)
+    elif n % nrow == 0:
+        axes = ("row",)
+    else:
+        return arr
+    spec = P(axes, *([None] * (arr.ndim - 1)))
+    return jax.device_put(arr, NamedSharding(m, spec))
+
+
 def make_mesh(devices=None, col_axis: int | None = None) -> Mesh:
     """2D ('col', 'row') mesh over the given (or all) devices.
 

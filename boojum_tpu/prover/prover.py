@@ -97,9 +97,15 @@ def _deep_main_sum(all_lde_flat, y0s, y1s, c0s, c1s, inv_xz):
 
 
 def _commit_columns(lde, cap_size):
-    """lde: (B, L, n) -> Merkle tree over (L*n, B) leaves."""
+    """lde: (B, L, n) -> Merkle tree over (L*n, B) leaves.
+
+    Under an active prover mesh the transpose is the col->row layout pivot:
+    leaves re-shard across both mesh axes (one all-to-all over ICI) so leaf
+    hashing is row-parallel."""
+    from ..parallel.sharding import shard_leaves
+
     B = lde.shape[0]
-    leaves = lde.reshape(B, -1).T
+    leaves = shard_leaves(lde.reshape(B, -1).T)
     return MerkleTreeWithCap(leaves, cap_size), leaves
 
 
@@ -126,9 +132,17 @@ def _vanishing_inv_brev(log_n, lde_factor):
     return jnp.repeat(per_coset, n)
 
 
-def prove(assembly, setup, config: ProofConfig) -> Proof:
+def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
+    """Prove; with `mesh` (a jax.sharding.Mesh from parallel.make_mesh) the
+    polynomial work shards over the mesh ('col' axis for per-column phases,
+    both axes for leaf hashing) and produces a byte-identical proof."""
+    from ..parallel.sharding import prover_mesh
+
     clock = _StageClock()
     try:
+        if mesh is not None:
+            with prover_mesh(mesh):
+                return _prove_impl(assembly, setup, config, clock)
         return _prove_impl(assembly, setup, config, clock)
     finally:
         clock.stop()
@@ -171,6 +185,12 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     if M:
         cols.append(jnp.asarray(assembly.multiplicities)[None, :])
     witness_cols = jnp.concatenate(cols, axis=0) if len(cols) > 1 else cols[0]
+    from ..parallel.sharding import shard_cols
+
+    witness_cols = shard_cols(witness_cols)
+    # round 2 consumes copy_vals directly: shard it too or the heaviest
+    # column phase (grand product + lookup polys) stays replicated
+    copy_vals = shard_cols(copy_vals)
     wit_mono = monomial_from_values(witness_cols)
     wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
     wit_tree, _ = _commit_columns(wit_lde, cap)
@@ -183,7 +203,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 2: copy-permutation + lookup stage 2 ----------------------
     clock.start("round2_stage2_commit")
-    sigma_dev = jnp.asarray(setup.sigma_cols)
+    sigma_dev = shard_cols(jnp.asarray(setup.sigma_cols))
     z, partials, chunks = compute_copy_permutation_stage2(
         copy_vals, sigma_dev, setup.non_residues, beta, gamma,
         geometry.max_allowed_constraint_degree,
@@ -201,7 +221,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         for a in a_polys:
             stage2_list += [a[0], a[1]]
         stage2_list += [b_poly[0], b_poly[1]]
-    stage2_cols = jnp.stack(stage2_list)
+    stage2_cols = shard_cols(jnp.stack(stage2_list))
     s2_mono = monomial_from_values(stage2_cols)
     s2_lde = lde_from_monomial(s2_mono, L)
     s2_tree, _ = _commit_columns(s2_lde, cap)
@@ -213,7 +233,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     wit_lde_all = wit_lde.reshape(Ct + W + M, N)
     copy_lde_flat = wit_lde_all[:Ct]
     gate_wit_lde = wit_lde_all[Ct : Ct + W] if W else None
-    setup_lde_flat = setup.setup_lde.reshape(Ct + K + TW, N)
+    setup_lde_flat = shard_cols(setup.setup_lde.reshape(Ct + K + TW, N))
     sigma_lde_flat = setup_lde_flat[:Ct]
     const_lde_flat = setup_lde_flat[Ct : Ct + K]
     table_lde_flat = setup_lde_flat[Ct + K :]
@@ -304,7 +324,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     for i in range(L):
         for comp in (0, 1):
             q_cols.append(T_mono[comp][i * n : (i + 1) * n])
-    q_mono = jnp.stack(q_cols)  # (2L, n) already monomial
+    q_mono = shard_cols(jnp.stack(q_cols))  # (2L, n) already monomial
     q_lde = lde_from_monomial(q_mono, L)
     q_tree, _ = _commit_columns(q_lde, cap)
     t.witness_merkle_tree_cap(q_tree.get_cap())
@@ -346,13 +366,15 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
     clock.start("round5_deep_fri")
-    all_lde_flat = jnp.concatenate(
-        [
-            wit_lde_all,
-            setup_lde_flat,
-            s2_lde_flat,
-            q_lde.reshape(2 * L, N),
-        ]
+    all_lde_flat = shard_cols(
+        jnp.concatenate(
+            [
+                wit_lde_all,
+                setup_lde_flat,
+                s2_lde_flat,
+                q_lde.reshape(2 * L, N),
+            ]
+        )
     )
     # 1/(x - z), 1/(x - z*omega) over the domain (ext)
     x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),

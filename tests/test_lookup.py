@@ -6,9 +6,6 @@ import numpy as np
 import pytest
 
 from boojum_tpu.cs.types import CSGeometry, LookupParameters
-from boojum_tpu.cs.implementations import ConstraintSystem
-from boojum_tpu.cs.lookup_table import LookupTable, range_check_table
-from boojum_tpu.cs.gates import FmaGate, PublicInputGate
 from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
 from boojum_tpu.prover.satisfiability import check_if_satisfied
 from boojum_tpu.prover.proof import Proof
@@ -32,28 +29,12 @@ CONFIG = ProofConfig(
 )
 
 
-def xor4_table():
-    a = np.arange(16, dtype=np.uint64).repeat(16)
-    b = np.tile(np.arange(16, dtype=np.uint64), 16)
-    return LookupTable("xor4", 2, 1, np.stack([a, b, a ^ b], axis=1))
-
-
 def build_circuit(num_lookups=30):
-    cs = ConstraintSystem(GEOM, 1 << 10, lookup_params=LOOKUP)
-    xor_id = cs.add_lookup_table(xor4_table())
-    rc_id = cs.add_lookup_table(range_check_table(4))
-    rng = np.random.default_rng(7)
-    acc = cs.alloc_variable_with_value(1)
-    last_out = None
-    for _ in range(num_lookups):
-        a = cs.alloc_variable_with_value(int(rng.integers(16)))
-        b = cs.alloc_variable_with_value(int(rng.integers(16)))
-        (out,) = cs.perform_lookup(xor_id, [a, b])
-        cs.enforce_lookup(rc_id, [out, cs.zero_var()])
-        acc = FmaGate.fma(cs, acc, out, a, 1, 1)
-        last_out = out
-    PublicInputGate.place(cs, acc)
-    return cs, acc, last_out
+    from boojum_tpu.examples import build_xor_lookup_circuit
+
+    return build_xor_lookup_circuit(
+        num_lookups, geometry=GEOM, lookup_params=LOOKUP
+    )
 
 
 def test_lookup_satisfiability():
