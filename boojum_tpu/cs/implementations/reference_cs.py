@@ -527,8 +527,13 @@ class CSAssembly:
             uniq, ucounts = np.unique(stacked, axis=1, return_counts=True)
             for u in range(uniq.shape[1]):
                 tid = int(uniq[0, u])
-                if tid == 0:
-                    continue
+                # tid 0 on a lookup row is a hard error everywhere else
+                # (satisfiability checker rejects it); recounting must not
+                # silently skip it and prove with inconsistent bookkeeping
+                assert tid != 0, (
+                    "lookup row with table id 0 while recounting "
+                    "multiplicities from an external witness"
+                )
                 table = self.lookup_tables[tid - 1]
                 col = uniq[1:, u]
                 for s in range(R):

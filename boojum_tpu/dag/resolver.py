@@ -131,6 +131,7 @@ class NativeTapeResolver(WitnessResolver):
         self._tape = NativeTape(lib)
         self._pending: set[int] = set()
         self._max_place = -1
+        self._poison: Exception | None = None
 
     def _available(self, place: int) -> bool:
         return (
@@ -141,7 +142,17 @@ class NativeTapeResolver(WitnessResolver):
         if not len(self._tape):
             return
         self._ensure(self._max_place)
-        out_places = self._tape.execute(self.values)
+        try:
+            out_places = self._tape.execute(self.values)
+        except Exception as e:
+            # the tape is consumed even on failure (partial execution; a
+            # rerun would double-bump lookup multiplicities), so the
+            # still-pending places can never materialize: poison the
+            # resolver so later reads surface THIS error instead of a
+            # misleading 'place unresolved' assert.
+            self._pending.clear()
+            self._poison = e
+            raise
         self.resolved[np.array(out_places, dtype=np.int64)] = True
         self._pending.clear()
         # fire python waiters parked on natively-resolved places
@@ -158,6 +169,23 @@ class NativeTapeResolver(WitnessResolver):
                         self._num_pending -= 1
                         self._run(rec[1], rec[2], rec[3])
 
+    def _check_poison(self):
+        if self._poison is not None:
+            raise RuntimeError(
+                "witness resolution incomplete because an earlier native "
+                "resolution batch failed"
+            ) from self._poison
+
+    def wait_till_resolved(self):
+        self.flush()
+        self._check_poison()
+        super().wait_till_resolved()
+
+    def values_flat(self, count: int) -> np.ndarray:
+        self.flush()
+        self._check_poison()
+        return super().values_flat(count)
+
     def is_resolved(self, place: int) -> bool:
         if place in self._pending:
             self.flush()
@@ -166,6 +194,11 @@ class NativeTapeResolver(WitnessResolver):
     def get_value(self, place: int) -> int:
         if place in self._pending:
             self.flush()
+        if self._poison is not None and not super().is_resolved(place):
+            raise RuntimeError(
+                "witness place unresolved because an earlier native "
+                "resolution batch failed"
+            ) from self._poison
         return super().get_value(place)
 
     def add_resolution(self, ins, outs, fn, native=None, table=None):
@@ -187,10 +220,6 @@ class NativeTapeResolver(WitnessResolver):
             if any(p in self._pending for p in ins):
                 self.flush()
         super().add_resolution(ins, outs, fn)
-
-    def wait_till_resolved(self):
-        self.flush()
-        super().wait_till_resolved()
 
     def native_multiplicities(self, table_id: int):
         return self._tape.multiplicities_of(table_id)

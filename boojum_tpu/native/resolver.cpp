@@ -213,8 +213,8 @@ enum OpKind : i64 {
   OP_U32_FMA = 7,     // ins a,b,c,cin; outs alo,ahi,blo,bhi,low,high,k
   OP_BYTE_TRIADD = 8, // ins 12 bytes; outs 4 bytes + carry
   OP_POSEIDON2 = 9,   // ins 12; outs 12 + 106
-  OP_LOOKUP = 10,     // params table_id; ins num_keys; outs num_values (bumps)
-  OP_LOOKUP_BUMP = 11 // params table_id; ins width (full tuple); no outs
+  OP_LOOKUP = 10,     // params table_id; ins num_keys; outs num_values (read-only)
+  OP_LOOKUP_BUMP = 11 // params table_id; ins width (full tuple); no outs; owns the multiplicity counter
 };
 
 // Executes ops [0, n_ops). Returns 0 on success, or 1-based index of the

@@ -192,10 +192,16 @@ class AlphaPows:
         while cap < max(count, 1):
             cap *= 2
         self.p0, self.p1 = ext_powers_device(alpha, cap)
+        self.count = count
         self.cursor = 0
 
     def take(self, k: int):
-        """(k,)-shaped ext power pair slice."""
+        """(k,)-shaped ext power pair slice. Over-consumption is a prover
+        term-count bug; fail loudly (a silent short slice would corrupt the
+        challenge combination into an invalid proof)."""
+        assert self.cursor + k <= self.count, (
+            f"AlphaPows over-consumed: {self.cursor}+{k} > {self.count}"
+        )
         s = slice(self.cursor, self.cursor + k)
         self.cursor += k
         return (self.p0[s], self.p1[s])

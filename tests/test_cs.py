@@ -177,3 +177,33 @@ def test_native_flush_with_far_waiter():
     assert r.get_value(1) == 5
     r.set_value(100000, 9)
     assert r.get_value(out) == 10
+
+
+def test_native_resolver_poison_on_failed_batch():
+    """A failed native batch (lookup miss) poisons the resolver: the original
+    error surfaces (chained) on every later read instead of a misleading
+    'place unresolved' assert."""
+    import pytest
+
+    from boojum_tpu.dag import make_resolver
+    from boojum_tpu.dag.resolver import NativeTapeResolver
+    from boojum_tpu.native import OP_LOOKUP
+    from boojum_tpu.examples import xor4_table
+
+    r = make_resolver(capacity=64)
+    if not isinstance(r, NativeTapeResolver):
+        pytest.skip("native engine unavailable")
+    table = xor4_table()
+    r.set_value(0, 99)  # not a valid xor4 key (keys are 0..15)
+    r.set_value(1, 3)
+    r.add_resolution([0, 1], [2], None, native=(OP_LOOKUP, (1,)), table=table)
+    with pytest.raises(RuntimeError, match="native"):
+        r.get_value(2)
+    # subsequent reads surface the poisoning, chained to the root cause
+    with pytest.raises(RuntimeError, match="native") as ei:
+        r.get_value(2)
+    assert ei.value.__cause__ is not None
+    with pytest.raises(RuntimeError, match="native"):
+        r.wait_till_resolved()
+    with pytest.raises(RuntimeError, match="native"):
+        r.values_flat(3)

@@ -46,6 +46,48 @@ def test_recursive_verifier_satisfiable():
     assert check_if_satisfied(outer_asm, verbose=True)
 
 
+def test_recursive_verifier_lookup_pow():
+    """The in-circuit verifier's lookup-argument and PoW branches, exercised
+    with the geometry real Era-style circuits use (lookups on, pow_bits>0):
+    satisfiable on the honest proof, unsatisfiable on a tampered lookup
+    opening and on a tampered PoW nonce."""
+    from boojum_tpu.examples import build_xor_lookup_circuit
+
+    cfg = ProofConfig(
+        fri_lde_factor=8,
+        merkle_tree_cap_size=4,
+        num_queries=4,
+        pow_bits=4,
+        fri_final_degree=4,
+    )
+    cs, _, _ = build_xor_lookup_circuit(num_lookups=8)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, cfg)
+    proof = prove(asm, setup, cfg)
+    assert verify(setup.vk, proof, asm.gates)
+
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    pi_vars, _cap = recursive_verify(outer, setup.vk, proof, asm.gates)
+    assert [outer.get_value(v) for v in pi_vars] == list(proof.public_inputs)
+    assert check_if_satisfied(outer.into_assembly(), verbose=True)
+
+    # tampered lookup sum opening (values at 0) must be unsatisfiable
+    bad = Proof.from_json(proof.to_json())
+    v = list(bad.values_at_0[0])
+    v[0] = (v[0] + 1) % gl.P
+    bad.values_at_0[0] = tuple(v)
+    outer2 = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    recursive_verify(outer2, setup.vk, bad, asm.gates)
+    assert not check_if_satisfied(outer2.into_assembly())
+
+    # tampered PoW nonce must be unsatisfiable
+    bad2 = Proof.from_json(proof.to_json())
+    bad2.pow_challenge += 1
+    outer3 = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    recursive_verify(outer3, setup.vk, bad2, asm.gates)
+    assert not check_if_satisfied(outer3.into_assembly())
+
+
 def test_recursive_verifier_rejects_bad_proof():
     vk, proof, gates = _prove_inner()
     bad = Proof.from_json(proof.to_json())
