@@ -1,0 +1,34 @@
+"""Byte-level compatibility layer with the reference (Rust) Boojum dialect.
+
+`serde` parses the reference's `proof.json` / `vk.json` artifacts, `transcript`
+replays its Fiat-Shamir transcript bit-for-bit, and `verifier` runs the
+reference verification algorithm (`/root/reference/src/cs/implementations/
+verifier.rs:888`) on host. Verifying the repo's golden Era main-VM proof pins
+Poseidon2, sponge/transcript byte order, Merkle/cap hashing, BoolsBuffer query
+drawing, FRI folding schedules, and DEEP quotening to the Rust implementation.
+The gate-constraint evaluators in `gates` follow the reference gate sources
+but are NOT pinned by the golden artifacts: the quotient identity at z needs
+the external era-zkevm_circuits gate configuration (see verifier docstring).
+"""
+
+from .serde import ReferenceProof, ReferenceVk, load_proof, load_vk
+from .transcript import BoolsBuffer, ReferenceTranscript
+from .verifier import (
+    compute_fri_schedule,
+    era_main_vm_verifier_config,
+    make_non_residues,
+    verify_reference_proof,
+)
+
+__all__ = [
+    "ReferenceProof",
+    "ReferenceVk",
+    "load_proof",
+    "load_vk",
+    "BoolsBuffer",
+    "ReferenceTranscript",
+    "compute_fri_schedule",
+    "era_main_vm_verifier_config",
+    "make_non_residues",
+    "verify_reference_proof",
+]

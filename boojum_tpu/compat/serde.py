@@ -1,0 +1,257 @@
+"""Deserialization of the reference's serde-JSON proof/VK artifacts.
+
+Counterparts: `/root/reference/src/cs/implementations/proof.rs:121` (Proof),
+`verifier.rs:31` (VerificationKey), `verifier.rs:66`
+(VerificationKeyCircuitGeometry), `setup.rs:1374` (TreeNode/GateDescription).
+Extension values serialize as `{"coeffs": [c0, c1]}`; caps as lists of
+4-element digests; the selector placement tree as nested
+`{"Fork": {...}}`/`{"GateOnly": {...}}`/`"Empty"` serde-enum JSON.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+
+
+@dataclass
+class GateDescription:
+    gate_idx: int
+    num_constants: int
+    degree: int
+    needs_selector: bool
+    is_lookup: bool
+
+
+class TreeNode:
+    """Selector placement tree (reference setup.rs:1374). `kind` is one of
+    'Empty' | 'GateOnly' | 'Fork'."""
+
+    def __init__(self, kind, gate=None, left=None, right=None):
+        self.kind = kind
+        self.gate = gate
+        self.left = left
+        self.right = right
+
+    @classmethod
+    def from_json(cls, obj) -> "TreeNode":
+        if obj == "Empty":
+            return cls("Empty")
+        if "GateOnly" in obj:
+            return cls("GateOnly", gate=GateDescription(**obj["GateOnly"]))
+        if "Fork" in obj:
+            f = obj["Fork"]
+            return cls(
+                "Fork",
+                left=cls.from_json(f["left"]),
+                right=cls.from_json(f["right"]),
+            )
+        raise ValueError(f"unknown TreeNode variant: {obj!r}")
+
+    def to_json(self):
+        if self.kind == "Empty":
+            return "Empty"
+        if self.kind == "GateOnly":
+            return {"GateOnly": dict(self.gate.__dict__)}
+        return {
+            "Fork": {
+                "left": self.left.to_json(),
+                "right": self.right.to_json(),
+            }
+        }
+
+    def output_placement(self, gate_idx: int):
+        """Root-to-leaf bool path for the gate, True = left (setup.rs:1439)."""
+        if self.kind == "Empty":
+            return None
+        if self.kind == "GateOnly":
+            return [] if self.gate.gate_idx == gate_idx else None
+        left = self.left.output_placement(gate_idx)
+        if left is not None:
+            return [True] + left
+        right = self.right.output_placement(gate_idx)
+        if right is not None:
+            return [False] + right
+        return None
+
+    def compute_stats(self, depth: int = 0):
+        """(max constraint degree incl. selector, max constants used) —
+        reference compute_stats_at_depth (setup.rs:1412)."""
+        if self.kind == "Empty":
+            assert depth == 0
+            return (0, 0)
+        if self.kind == "GateOnly":
+            g = self.gate
+            if g.is_lookup:
+                deg = max(depth, 2)
+            else:
+                deg = depth + g.degree
+            return (deg, g.num_constants + depth)
+        ls = self.left.compute_stats(depth + 1)
+        rs = self.right.compute_stats(depth + 1)
+        return (max(ls[0], rs[0]), max(ls[1], rs[1]))
+
+
+@dataclass
+class LookupParametersRef:
+    mode: str  # serde variant name
+    width: int
+    num_repetitions: int
+    share_table_id: bool
+
+    @classmethod
+    def from_json(cls, obj) -> "LookupParametersRef":
+        if obj == "NoLookup":
+            return cls("NoLookup", 0, 0, False)
+        (mode, body), = obj.items()
+        return cls(
+            mode,
+            int(body.get("width", 0)),
+            int(body.get("num_repetitions", 0)),
+            bool(body.get("share_table_id", False)),
+        )
+
+    @property
+    def is_lookup(self) -> bool:
+        return self.mode != "NoLookup"
+
+    def specialized_columns_per_subargument(self) -> int:
+        """Variable columns one specialized sub-argument occupies
+        (reference cs/mod.rs LookupParameters)."""
+        if self.mode == "UseSpecializedColumnsWithTableIdAsConstant":
+            return self.width
+        if self.mode == "UseSpecializedColumnsWithTableIdAsVariable":
+            return self.width + 1
+        raise ValueError("not a specialized-columns mode")
+
+
+@dataclass
+class ReferenceVk:
+    # geometry (CSGeometry)
+    num_columns_under_copy_permutation: int
+    num_witness_columns: int
+    num_constant_columns: int
+    max_allowed_constraint_degree: int
+    # the rest of VerificationKeyCircuitGeometry
+    lookup_parameters: LookupParametersRef
+    domain_size: int
+    total_tables_len: int
+    public_inputs_locations: list  # [(column, row)]
+    extra_constant_polys_for_selectors: int
+    table_ids_column_idxes: list
+    quotient_degree: int
+    selectors_placement: TreeNode
+    fri_lde_factor: int
+    cap_size: int
+    setup_merkle_tree_cap: list  # [[4 ints]]
+
+
+def _ext(obj):
+    return (int(obj["coeffs"][0]), int(obj["coeffs"][1]))
+
+
+@dataclass
+class OracleQueryRef:
+    leaf_elements: list
+    proof: list  # list of 4-int digests
+
+
+@dataclass
+class QueriesRef:
+    witness: OracleQueryRef
+    stage_2: OracleQueryRef
+    quotient: OracleQueryRef
+    setup: OracleQueryRef
+    fri: list  # [OracleQueryRef]
+
+
+@dataclass
+class ReferenceProof:
+    proof_config: dict
+    public_inputs: list
+    witness_oracle_cap: list
+    stage_2_oracle_cap: list
+    quotient_oracle_cap: list
+    final_fri_monomials: tuple  # (list c0, list c1)
+    values_at_z: list  # [(c0, c1)]
+    values_at_z_omega: list
+    values_at_0: list
+    fri_base_oracle_cap: list
+    fri_intermediate_oracles_caps: list
+    queries_per_fri_repetition: list  # [QueriesRef]
+    pow_challenge: int
+
+
+def _query(obj) -> OracleQueryRef:
+    return OracleQueryRef(
+        leaf_elements=[int(x) for x in obj["leaf_elements"]],
+        proof=[tuple(int(x) for x in d) for d in obj["proof"]],
+    )
+
+
+def _cap(obj):
+    return [tuple(int(x) for x in d) for d in obj]
+
+
+def load_vk(path: str) -> ReferenceVk:
+    raw = json.load(open(path))
+    fp = raw["fixed_parameters"]
+    geo = fp["parameters"]
+    return ReferenceVk(
+        num_columns_under_copy_permutation=geo[
+            "num_columns_under_copy_permutation"
+        ],
+        num_witness_columns=geo["num_witness_columns"],
+        num_constant_columns=geo["num_constant_columns"],
+        max_allowed_constraint_degree=geo["max_allowed_constraint_degree"],
+        lookup_parameters=LookupParametersRef.from_json(
+            fp["lookup_parameters"]
+        ),
+        domain_size=int(fp["domain_size"]),
+        total_tables_len=int(fp["total_tables_len"]),
+        public_inputs_locations=[
+            (int(c), int(r)) for c, r in fp["public_inputs_locations"]
+        ],
+        extra_constant_polys_for_selectors=int(
+            fp["extra_constant_polys_for_selectors"]
+        ),
+        table_ids_column_idxes=[int(i) for i in fp["table_ids_column_idxes"]],
+        quotient_degree=int(fp["quotient_degree"]),
+        selectors_placement=TreeNode.from_json(fp["selectors_placement"]),
+        fri_lde_factor=int(fp["fri_lde_factor"]),
+        cap_size=int(fp["cap_size"]),
+        setup_merkle_tree_cap=_cap(raw["setup_merkle_tree_cap"]),
+    )
+
+
+def load_proof(path: str) -> ReferenceProof:
+    raw = json.load(open(path))
+    return ReferenceProof(
+        proof_config=raw["proof_config"],
+        public_inputs=[int(x) for x in raw["public_inputs"]],
+        witness_oracle_cap=_cap(raw["witness_oracle_cap"]),
+        stage_2_oracle_cap=_cap(raw["stage_2_oracle_cap"]),
+        quotient_oracle_cap=_cap(raw["quotient_oracle_cap"]),
+        final_fri_monomials=(
+            [int(x) for x in raw["final_fri_monomials"][0]],
+            [int(x) for x in raw["final_fri_monomials"][1]],
+        ),
+        values_at_z=[_ext(v) for v in raw["values_at_z"]],
+        values_at_z_omega=[_ext(v) for v in raw["values_at_z_omega"]],
+        values_at_0=[_ext(v) for v in raw["values_at_0"]],
+        fri_base_oracle_cap=_cap(raw["fri_base_oracle_cap"]),
+        fri_intermediate_oracles_caps=[
+            _cap(c) for c in raw["fri_intermediate_oracles_caps"]
+        ],
+        queries_per_fri_repetition=[
+            QueriesRef(
+                witness=_query(q["witness_query"]),
+                stage_2=_query(q["stage_2_query"]),
+                quotient=_query(q["quotient_query"]),
+                setup=_query(q["setup_query"]),
+                fri=[_query(f) for f in q["fri_queries"]],
+            )
+            for q in raw["queries_per_fri_repetition"]
+        ],
+        pow_challenge=int(raw["pow_challenge"]),
+    )

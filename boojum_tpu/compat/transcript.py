@@ -1,0 +1,34 @@
+"""Reference Fiat-Shamir transcript, bit-for-bit.
+
+`boojum_tpu.transcript.Poseidon2Transcript` / `BitSource` already implement
+the reference semantics (`GoldilocksPoisedon2Transcript`,
+/root/reference/src/cs/implementations/transcript.rs:48, and `BoolsBuffer`,
+:369); the golden-artifact tests pin them to the Rust bytes, so the compat
+layer aliases them under the reference names rather than keeping a second
+copy of security-critical Fiat-Shamir code.
+"""
+
+from __future__ import annotations
+
+from ..transcript import BitSource, Poseidon2Transcript
+
+ReferenceTranscript = Poseidon2Transcript
+
+
+class BoolsBuffer(BitSource):
+    """Reference-named view of BitSource (`available` alias included for
+    parity with the Rust field names)."""
+
+    def __init__(self, max_needed: int):
+        super().__init__(max_needed)
+
+    @property
+    def available(self):
+        return self.bits
+
+
+def u64_from_lsb_first_bits(bits) -> int:
+    out = 0
+    for shift, bit in enumerate(bits):
+        out |= int(bool(bit)) << shift
+    return out
