@@ -101,6 +101,39 @@ def main():
         proof = prove(asm, setup, config)
     wall = (time.perf_counter() - t0) / reps
 
+    # NTT throughput (BASELINE.md tracked metric): Goldilocks elems/s for a
+    # batched forward+inverse pair at bench scale, warm
+    ntt_eps = None
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from boojum_tpu.ntt import (
+            fft_natural_to_bitreversed,
+            ifft_bitreversed_to_natural,
+        )
+
+        cols, log_n = 64, 16
+        rng = np.random.default_rng(0)
+        from boojum_tpu.field import gl
+
+        a = jnp.asarray(
+            rng.integers(0, gl.P, size=(cols, 1 << log_n), dtype=np.uint64)
+        )
+        jax.block_until_ready(
+            ifft_bitreversed_to_natural(fft_natural_to_bitreversed(a))
+        )  # compile
+        t1 = time.perf_counter()
+        ntt_reps = 4
+        for _ in range(ntt_reps):
+            a = ifft_bitreversed_to_natural(fft_natural_to_bitreversed(a))
+        jax.block_until_ready(a)
+        dt = time.perf_counter() - t1
+        ntt_eps = int(2 * ntt_reps * cols * (1 << log_n) / dt)
+    except Exception:
+        pass
+
     base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
     vs = 1.0
     if os.path.exists(base_path):
@@ -110,12 +143,15 @@ def main():
                 vs = base["value"] / wall
         except Exception:
             pass
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": round(wall, 4),
         "unit": "s",
         "vs_baseline": round(vs, 3),
-    }))
+    }
+    if ntt_eps is not None:
+        out["ntt_goldilocks_elems_per_s"] = ntt_eps
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

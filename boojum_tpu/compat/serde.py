@@ -13,83 +13,16 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 
+from ..cs.selector_tree import GateDescription, TreeNode
 
-@dataclass
-class GateDescription:
-    gate_idx: int
-    num_constants: int
-    degree: int
-    needs_selector: bool
-    is_lookup: bool
-
-
-class TreeNode:
-    """Selector placement tree (reference setup.rs:1374). `kind` is one of
-    'Empty' | 'GateOnly' | 'Fork'."""
-
-    def __init__(self, kind, gate=None, left=None, right=None):
-        self.kind = kind
-        self.gate = gate
-        self.left = left
-        self.right = right
-
-    @classmethod
-    def from_json(cls, obj) -> "TreeNode":
-        if obj == "Empty":
-            return cls("Empty")
-        if "GateOnly" in obj:
-            return cls("GateOnly", gate=GateDescription(**obj["GateOnly"]))
-        if "Fork" in obj:
-            f = obj["Fork"]
-            return cls(
-                "Fork",
-                left=cls.from_json(f["left"]),
-                right=cls.from_json(f["right"]),
-            )
-        raise ValueError(f"unknown TreeNode variant: {obj!r}")
-
-    def to_json(self):
-        if self.kind == "Empty":
-            return "Empty"
-        if self.kind == "GateOnly":
-            return {"GateOnly": dict(self.gate.__dict__)}
-        return {
-            "Fork": {
-                "left": self.left.to_json(),
-                "right": self.right.to_json(),
-            }
-        }
-
-    def output_placement(self, gate_idx: int):
-        """Root-to-leaf bool path for the gate, True = left (setup.rs:1439)."""
-        if self.kind == "Empty":
-            return None
-        if self.kind == "GateOnly":
-            return [] if self.gate.gate_idx == gate_idx else None
-        left = self.left.output_placement(gate_idx)
-        if left is not None:
-            return [True] + left
-        right = self.right.output_placement(gate_idx)
-        if right is not None:
-            return [False] + right
-        return None
-
-    def compute_stats(self, depth: int = 0):
-        """(max constraint degree incl. selector, max constants used) —
-        reference compute_stats_at_depth (setup.rs:1412)."""
-        if self.kind == "Empty":
-            assert depth == 0
-            return (0, 0)
-        if self.kind == "GateOnly":
-            g = self.gate
-            if g.is_lookup:
-                deg = max(depth, 2)
-            else:
-                deg = depth + g.degree
-            return (deg, g.num_constants + depth)
-        ls = self.left.compute_stats(depth + 1)
-        rs = self.right.compute_stats(depth + 1)
-        return (max(ls[0], rs[0]), max(ls[1], rs[1]))
+__all__ = [
+    "GateDescription",
+    "TreeNode",
+    "ReferenceVk",
+    "ReferenceProof",
+    "load_vk",
+    "load_proof",
+]
 
 
 @dataclass

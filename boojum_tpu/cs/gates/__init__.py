@@ -8,6 +8,7 @@ from .simple import (
     ReductionGate,
     SelectionGate,
     ZeroCheckGate,
+    ZeroCheckWitnessGate,
     ParallelSelectionGate,
     ConditionalSwapGate,
     DotProductGate,

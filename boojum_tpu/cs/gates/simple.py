@@ -511,6 +511,57 @@ class ZeroCheckGate(Gate):
         return cls._inst
 
 
+class ZeroCheckWitnessGate(Gate):
+    """out = (x == 0) with the inverse aux in a WITNESS column (reference
+    zero_check.rs `use_witness_column_for_inversion = true`, :591): same two
+    constraints as ZeroCheckGate but the aux value lives outside the
+    copy-permutation — it is never wired to anything, so a witness column
+    (no sigma poly, no copy chain) carries it for free.
+    """
+
+    name = "zero_check_wit"
+    principal_width = 2
+    witness_width = 1
+    num_terms = 2
+    max_degree = 2
+
+    def evaluate(self, ops, row, dst):
+        x, out, aux = row.v(0), row.v(1), row.w(0)
+        dst.push(ops.mul(x, out))
+        one = ops.one()
+        dst.push(ops.sub(ops.sub(one, out), ops.mul(x, aux)))
+
+    def padding_instance(self, cs, constants=()):
+        # x=0, out=1; the padded witness cell scatters to 0: 0*1 = 0 and
+        # 1 - 1 - 0*0 = 0
+        return [cs.zero_var(), cs.one_var()]
+
+    @staticmethod
+    def is_zero(cs, x):
+        out = cs.alloc_variable_without_value()
+        aux = cs.alloc_witness_without_value()
+
+        def resolve(vals):
+            (xv,) = vals
+            if xv == 0:
+                return [1, 0]
+            return [0, gl.inv(xv)]
+
+        cs.set_values_with_dependencies([x], [out, aux], resolve)
+        cs.place_gate(
+            ZeroCheckWitnessGate.instance(), [x, out], (), wit_places=[aux]
+        )
+        return out
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst
+
+
 class SimpleNonlinearityGate(Gate):
     """y = x^7 + c (reference simple_non_linearity_with_constant.rs)."""
 

@@ -33,14 +33,18 @@ class ConstraintSystem:
         max_trace_len: int,
         config: CSConfig = DEV_CS_CONFIG,
         lookup_params: LookupParameters | None = None,
+        resolver=None,
     ):
         self.geometry = geometry
         self.max_trace_len = max_trace_len
         self.config = config
         self.lookup_params = lookup_params or LookupParameters()
-        self.resolver = (
-            make_resolver() if config.evaluate_witness else NullResolver()
-        )
+        if resolver is not None:
+            self.resolver = resolver
+        else:
+            self.resolver = (
+                make_resolver() if config.evaluate_witness else NullResolver()
+            )
         self.next_var_idx = 0
         self.next_wit_idx = 0
         c = geometry.num_columns_under_copy_permutation

@@ -29,6 +29,27 @@ class WitnessResolver:
         # place -> list of closure records waiting on it
         self._waiters: dict[int, list] = {}
         self._num_pending = 0
+        # record/playback (reference mt/sorters/sorter_live.rs): when
+        # recording, every registered resolution gets a sequential id and
+        # the record lists ids in EXECUTION order
+        self._record: list[int] | None = None
+        self._reg_counter = 0
+
+    # -- record / playback ---------------------------------------------------
+
+    def start_recording(self):
+        """Record the resolution execution order for deterministic replay
+        (reference ResolutionRecord, dag/resolvers/mt/sorters/)."""
+        assert self._reg_counter == 0, "recording must start before synthesis"
+        self._record = []
+
+    def resolution_record(self) -> list[int]:
+        assert self._record is not None, "recording was not enabled"
+        return list(self._record)
+
+    def _log_execution(self, reg_id: int):
+        if self._record is not None:
+            self._record.append(reg_id)
 
     # -- storage ------------------------------------------------------------
 
@@ -61,6 +82,7 @@ class WitnessResolver:
                 rec[0] -= 1
                 if rec[0] == 0:
                     self._num_pending -= 1
+                    self._log_execution(rec[4])
                     self._run(rec[1], rec[2], rec[3])
 
     # -- resolutions --------------------------------------------------------
@@ -72,11 +94,14 @@ class WitnessResolver:
         (a typed-op descriptor) and `table` are accepted for signature parity
         with NativeTapeResolver and ignored here.
         """
+        reg_id = self._reg_counter
+        self._reg_counter += 1
         missing = [p for p in ins if not self.is_resolved(p)]
         if not missing:
+            self._log_execution(reg_id)
             self._run(ins, outs, fn)
             return
-        rec = [len(missing), ins, outs, fn]
+        rec = [len(missing), ins, outs, fn, reg_id]
         self._num_pending += 1
         for p in missing:
             self._waiters.setdefault(p, []).append(rec)
@@ -167,6 +192,7 @@ class NativeTapeResolver(WitnessResolver):
                     rec[0] -= 1
                     if rec[0] == 0:
                         self._num_pending -= 1
+                        self._log_execution(rec[4])
                         self._run(rec[1], rec[2], rec[3])
 
     def _check_poison(self):
@@ -203,6 +229,10 @@ class NativeTapeResolver(WitnessResolver):
 
     def add_resolution(self, ins, outs, fn, native=None, table=None):
         if native is not None and all(self._available(p) for p in ins):
+            # tape ops execute in append order at flush time: log now
+            reg_id = self._reg_counter
+            self._reg_counter += 1
+            self._log_execution(reg_id)
             kind, params = native
             if table is not None:
                 self._tape.ensure_table(int(params[0]), table)
@@ -223,6 +253,56 @@ class NativeTapeResolver(WitnessResolver):
 
     def native_multiplicities(self, table_id: int):
         return self._tape.multiplicities_of(table_id)
+
+
+class PlaybackResolver(WitnessResolver):
+    """Deterministic re-run driven by a prior run's resolution record
+    (reference `mt/sorters/sorter_playback.rs`): resolutions execute in
+    exactly the recorded order with no dependency tracking — each one's
+    inputs must already be resolved when its turn comes, otherwise the
+    synthesis diverged from the recorded run and playback raises."""
+
+    def __init__(self, record, capacity: int = 1 << 16):
+        super().__init__(capacity=capacity)
+        self._playback = list(record)
+        self._cursor = 0
+        self._parked: dict[int, tuple] = {}
+
+    def _drain(self):
+        while (
+            self._cursor < len(self._playback)
+            and self._playback[self._cursor] in self._parked
+        ):
+            nid = self._playback[self._cursor]
+            self._cursor += 1
+            pins, pouts, pfn = self._parked.pop(nid)
+            for p in pins:
+                if not self.is_resolved(p):
+                    raise RuntimeError(
+                        f"playback divergence: resolution {nid} input {p} "
+                        "not resolved at its recorded slot"
+                    )
+            self._run(pins, pouts, pfn)
+
+    def add_resolution(self, ins, outs, fn, native=None, table=None):
+        assert fn is not None, "playback needs the portable closure"
+        reg_id = self._reg_counter
+        self._reg_counter += 1
+        self._parked[reg_id] = (ins, outs, fn)
+        self._drain()
+
+    def get_value(self, place: int) -> int:
+        if not self.is_resolved(place):
+            self._drain()
+        return super().get_value(place)
+
+    def wait_till_resolved(self):
+        if self._cursor != len(self._playback) or self._parked:
+            raise RuntimeError(
+                "playback divergence: "
+                f"{len(self._playback) - self._cursor} recorded resolutions "
+                f"never ran, {len(self._parked)} registrations unmatched"
+            )
 
 
 def make_resolver(capacity: int = 1 << 16) -> WitnessResolver:

@@ -62,10 +62,37 @@ def _witness_value(cs, v):
     raise TypeError(f"{type(v)} is not witnessable")
 
 
+def encode_variables(v) -> list:
+    """Flatten a gadget (or nested structure of gadgets) into its ordered
+    list of circuit variables — the runtime face of the reference's
+    `CSVarLengthEncodable` derive
+    (`/root/reference/cs_derive/src/var_length_encodable/mod.rs`):
+    field-recursive, deterministic order, variable total length. The
+    encoding feeds commitment chains (queues) and public-input packing."""
+    if isinstance(v, (list, tuple)):
+        out = []
+        for x in v:
+            out.extend(encode_variables(x))
+        return out
+    if isinstance(v, (Num, Boolean)):
+        return [v.var]
+    if isinstance(v, int):  # a raw variable place
+        return [v]
+    if dataclasses.is_dataclass(v):
+        out = []
+        for f in dataclasses.fields(v):
+            out.extend(encode_variables(getattr(v, f.name)))
+        return out
+    if hasattr(v, "encode_vars"):
+        return list(v.encode_vars())
+    raise TypeError(f"{type(v)} is not var-length encodable")
+
+
 def derive_gadget(cls):
-    """Class decorator adding allocate / select / witness_hook to a
-    dataclass of gadget fields (the runtime face of the reference's
-    #[derive(CSAllocatable, CSSelectable, WitnessHookable)])."""
+    """Class decorator adding allocate / select / witness_hook /
+    encoding_length / encode_vars to a dataclass of gadget fields (the
+    runtime face of the reference's #[derive(CSAllocatable, CSSelectable,
+    WitnessHookable, CSVarLengthEncodable)])."""
     assert dataclasses.is_dataclass(cls), "derive_gadget expects a dataclass"
     import typing
 
@@ -97,12 +124,20 @@ def derive_gadget(cls):
 
         return hook
 
+    def encode_vars(self):
+        return encode_variables(self)
+
+    def encoding_length(self) -> int:
+        return len(encode_variables(self))
+
     cls.allocate = staticmethod(allocate)
     cls.select = staticmethod(select)
     cls.witness_hook = staticmethod(witness_hook)
+    cls.encode_vars = encode_vars
+    cls.encoding_length = encoding_length
     return cls
 
 
 # Make the scalar gadgets compose: Num/Boolean already provide
 # allocate/select/get_value with the right shapes.
-__all__ = ["derive_gadget"]
+__all__ = ["derive_gadget", "encode_variables"]

@@ -159,16 +159,15 @@ def recursive_verify(cs, vk, proof, gates):
     for v in ap.values_at_0:
         t.witness_field_elements(list(v))
     deep_ch = t.get_ext_challenge()
-    final_degree = vk.fri_final_degree
-    deg = n
-    num_folds = 0
-    while deg > final_degree:
-        deg //= 2
-        num_folds += 1
-    assert num_folds >= 1
-    assert len(proof.fri_caps) == num_folds
+    from ...prover.fri import fold_schedule
+
+    schedule = fold_schedule(
+        n, vk.fri_final_degree, getattr(vk, "fri_folding_schedule", None)
+    )
+    num_folds = sum(schedule)
+    assert len(proof.fri_caps) == len(schedule)
     fri_challenges = []
-    for r in range(num_folds):
+    for r in range(len(schedule)):
         t.witness_merkle_tree_cap(ap.fri_caps[r])
         fri_challenges.append(t.get_ext_challenge())
     assert len(proof.final_fri_monomials) == (n >> num_folds)
@@ -187,7 +186,6 @@ def recursive_verify(cs, vk, proof, gates):
     # ---- quotient identity at z ------------------------------------------
     alpha_pows = _PowIter(ops, alpha)
     total = ops.zero()
-    depth = max(len(p) for p in vk.selector_paths) if vk.selector_paths else 0
     for gid, gate in enumerate(gates):
         if gate.num_terms == 0:
             continue
@@ -201,7 +199,7 @@ def recursive_verify(cs, vk, proof, gates):
         for inst in range(reps):
             row = _ZRowView(
                 wit_vals, const_vals, inst * gate.principal_width,
-                inst * gate.witness_width, depth, Ct,
+                inst * gate.witness_width, len(path), Ct,
             )
             dst = TermsCollector()
             gate.evaluate(ops, row, dst)
@@ -379,40 +377,70 @@ def recursive_verify(cs, vk, proof, gates):
             tb = bops.mul(diff, denom)
             h_val = ops.add(h_val, ops.mul_by_base(ch, tb))
 
-        # FRI chain
-        assert len(q.fri) == num_folds
-        pairs = []
-        for r, oq in enumerate(q.fri):
-            pair_idx_bits = idx_bits[r + 1 :]
+        # FRI chain (grouped oracles per the folding schedule): each leaf
+        # carries a whole 2^k fold subtree; the circuit folds the entire
+        # leaf with sub-challenges ch, ch^2, ... (reference fri/mod.rs:362)
+        assert len(q.fri) == len(schedule)
+        cur_expected = None
+        off = 0
+        for r, (k_r, oq) in enumerate(zip(schedule, q.fri)):
+            block = 1 << k_r
+            assert len(oq.leaf_values) == 2 * block
+            leaf_idx_bits = idx_bits[off + k_r :]
             _verify_merkle_path(
                 cs, bops, oq.leaf_values, oq.path, ap.fri_caps[r],
-                pair_idx_bits,
+                leaf_idx_bits,
             )
-            even = (oq.leaf_values[0], oq.leaf_values[1])
-            odd = (oq.leaf_values[2], oq.leaf_values[3])
-            pairs.append((even, odd))
-        base_even, base_odd = pairs[0]
-        mine = ops.select(idx_bits[0], base_odd, base_even)
-        ops.enforce_equal(mine, h_val)
-
-        cur_expected = None
-        for r in range(num_folds):
-            log_nr = log_full - r
-            even, odd = pairs[r]
-            if cur_expected is not None:
-                mine = ops.select(idx_bits[r], odd, even)
+            points = [
+                (oq.leaf_values[2 * j], oq.leaf_values[2 * j + 1])
+                for j in range(block)
+            ]
+            # the value this query tracks = points muxed by the in-block bits
+            sel_vals = list(points)
+            for b in idx_bits[off : off + k_r]:
+                sel_vals = [
+                    ops.select(b, sel_vals[2 * i + 1], sel_vals[2 * i])
+                    for i in range(len(sel_vals) // 2)
+                ]
+            mine = sel_vals[0]
+            if cur_expected is None:
+                ops.enforce_equal(mine, h_val)
+            else:
                 ops.enforce_equal(mine, cur_expected)
-            # x_r = g^{2^r}·ω_r^{brev(k, log_nr - 1)}, k = idx >> (r+1)
-            k_bits = idx_bits[r + 1 : r + 1 + (log_nr - 1)]
-            omega_r = gl.pow_(omega_full, 1 << r)
-            shift_r = gl.pow_(g, 1 << r)
-            x_r = _point_from_bits(bops, k_bits, omega_r, shift_r)
+            # fold the whole leaf down k_r times
+            dbits = idx_bits[off + k_r : log_full]
+            fold_vals = points
             ch = fri_challenges[r]
-            s = ops.add(even, odd)
-            d = ops.sub(even, odd)
-            dox = ops.mul_by_base(d, bops.inv(x_r))
-            folded = ops.add(s, ops.mul(dox, ch))
-            cur_expected = ops.mul_by_base_constant(folded, INV2)
+            for j in range(k_r):
+                fr = off + j
+                log_nr = log_full - fr
+                omega_r = gl.pow_(omega_full, 1 << fr)
+                shift_r = gl.pow_(g, 1 << fr)
+                # the dbits product is invariant in m: synthesize it once
+                # per sub-fold, then scale by the per-m host constant
+                base_point = _point_from_bits(bops, dbits, omega_r, 1)
+                nxt = []
+                for m in range(len(fold_vals) // 2):
+                    # even element's global index: low bit 0, then the
+                    # STATIC bits of m, then the leaf index bits
+                    static_nat = 0
+                    for tbit in range(k_r - j - 1):
+                        if (m >> tbit) & 1:
+                            static_nat += 1 << (log_nr - 2 - tbit)
+                    shift_eff = gl.mul(
+                        shift_r, gl.pow_(omega_r, static_nat)
+                    )
+                    x_r = bops.mul(base_point, bops.constant(shift_eff))
+                    even, odd = fold_vals[2 * m], fold_vals[2 * m + 1]
+                    s = ops.add(even, odd)
+                    d = ops.sub(even, odd)
+                    dox = ops.mul_by_base(d, bops.inv(x_r))
+                    folded = ops.add(s, ops.mul(dox, ch))
+                    nxt.append(ops.mul_by_base_constant(folded, INV2))
+                fold_vals = nxt
+                ch = ops.mul(ch, ch)
+            cur_expected = fold_vals[0]
+            off += k_r
 
         # final monomial evaluation at the fully folded point
         log_fin = log_full - num_folds

@@ -10,7 +10,13 @@ class ProofConfig:
     num_queries: int = 50
     pow_bits: int = 0
     fri_final_degree: int = 64  # stop folding when poly degree <= this
+    # optional explicit FRI folding schedule: list of per-oracle fold counts
+    # (2^k-to-1 per oracle, reference fri/mod.rs interpolation schedule);
+    # None derives the reference-style greedy [3,3,...,rem] schedule
+    fri_folding_schedule: list | None = None
 
     def __post_init__(self):
         assert self.fri_lde_factor & (self.fri_lde_factor - 1) == 0
         assert self.merkle_tree_cap_size & (self.merkle_tree_cap_size - 1) == 0
+        if self.fri_folding_schedule is not None:
+            assert all(int(k) >= 1 for k in self.fri_folding_schedule)

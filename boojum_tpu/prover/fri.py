@@ -4,8 +4,11 @@ Counterpart of `/root/reference/src/cs/implementations/fri/mod.rs` (do_fri
 :49, fold_multiple :362, final monomial interpolation :476). The codeword is
 an ext-valued array over the full LDE domain in bit-reversed enumeration, so
 fold pairs (x, −x) are ADJACENT (even/odd lanes) and every fold round is two
-strided slices + vectorized butterfly — no gather. Each committed round
-interleaves (c0, c1) with two domain points per Merkle leaf.
+strided slices + vectorized butterfly — no gather. Oracles follow the folding
+schedule: each committed oracle groups 2^k brev-consecutive domain points
+(its whole fold subtree) per Merkle leaf, interleaving (c0, c1) per point,
+and answers k fold rounds with one drawn challenge (sub-challenges by
+squaring).
 """
 
 from __future__ import annotations
@@ -70,51 +73,88 @@ def fold_once(values, challenge, inv_x_pairs):
     return _fold_once_jit(values, ext_scalar(challenge), inv_x_pairs)
 
 
-def commit_codeword(values, cap_size: int) -> MerkleTreeWithCap:
-    """Commit ext codeword: rows (N, 2) = [c0, c1], two points per leaf."""
+def commit_codeword(
+    values, cap_size: int, elems_per_leaf: int = 2
+) -> MerkleTreeWithCap:
+    """Commit ext codeword: rows (N, 2) = [c0, c1]; `elems_per_leaf` domain
+    points per Merkle leaf (leaf regrouping, reference fri/mod.rs:362,699 —
+    one oracle then answers a whole 2^k fold subtree per query)."""
     arr = jnp.stack([values[0], values[1]], axis=-1)  # (N, 2)
-    return MerkleTreeWithCap(arr, cap_size, num_elems_per_leaf=2)
+    return MerkleTreeWithCap(arr, cap_size, num_elems_per_leaf=elems_per_leaf)
+
+
+def fold_schedule(
+    base_degree: int, final_degree: int, explicit=None
+) -> list[int]:
+    """Per-oracle fold counts (reference interpolation-log2 schedule,
+    prover.rs:2281): each oracle folds 2^k-to-1 with one drawn challenge
+    (sub-challenges by squaring). Greedy 3s then the remainder, unless an
+    explicit schedule is configured."""
+    num = 0
+    deg = base_degree
+    while deg > final_degree:
+        deg //= 2
+        num += 1
+    assert num >= 1, "nothing to fold; lower fri_final_degree"
+    if explicit is not None:
+        explicit = [int(k) for k in explicit]
+        assert sum(explicit) == num and all(k >= 1 for k in explicit), (
+            f"folding schedule {explicit} must sum to {num}"
+        )
+        return explicit
+    out = []
+    rem = num
+    while rem > 3:
+        out.append(3)
+        rem -= 3
+    out.append(rem)
+    return out
 
 
 class FriOracles:
     def __init__(self):
         self.trees: list[MerkleTreeWithCap] = []
-        self.values: list = []  # ext pairs per round (device)
-        self.challenges: list = []
+        self.values: list = []  # ext pairs per committed oracle (device)
+        self.challenges: list = []  # one drawn ext challenge per oracle
+        self.schedule: list[int] = []
         self.final_monomials = None  # host list of (c0, c1)
 
 
 def fri_prove(codeword, transcript, config, base_degree: int) -> FriOracles:
     """codeword: ext pair over full LDE domain (brev layout).
 
-    Protocol: commit base oracle -> absorb cap -> repeat [draw challenge,
-    fold; commit+absorb unless final] -> interpolate final monomials, absorb.
+    Protocol per schedule entry k: commit the current codeword with 2^k
+    points per leaf -> absorb cap -> draw ONE challenge -> fold k times with
+    challenges ch, ch^2, ch^4, ... -> next entry. Then interpolate the final
+    monomials and absorb them.
     """
     out = FriOracles()
     N = int(codeword[0].shape[0])
     log_full = N.bit_length() - 1
-    deg = base_degree
-    num_folds = 0
-    while deg > config.fri_final_degree:
-        deg //= 2
-        num_folds += 1
-    assert num_folds >= 1, "nothing to fold; lower fri_final_degree"
+    schedule = fold_schedule(
+        base_degree, config.fri_final_degree,
+        getattr(config, "fri_folding_schedule", None),
+    )
+    out.schedule = schedule
+    num_folds = sum(schedule)
     tables = fold_challenge_tables(log_full, num_folds)
 
     cur = codeword
-    tree = commit_codeword(cur, config.merkle_tree_cap_size)
-    out.trees.append(tree)
-    out.values.append(cur)
-    transcript.witness_merkle_tree_cap(tree.get_cap())
-    for r in range(num_folds):
+    fold_round = 0
+    for k in schedule:
+        tree = commit_codeword(
+            cur, config.merkle_tree_cap_size, elems_per_leaf=1 << k
+        )
+        out.trees.append(tree)
+        out.values.append(cur)
+        transcript.witness_merkle_tree_cap(tree.get_cap())
         ch = transcript.get_ext_challenge()
         out.challenges.append(ch)
-        cur = fold_once(cur, ch, tables[r])
-        if r + 1 < num_folds:
-            tree = commit_codeword(cur, config.merkle_tree_cap_size)
-            out.trees.append(tree)
-            out.values.append(cur)
-            transcript.witness_merkle_tree_cap(tree.get_cap())
+        sub = ch
+        for _ in range(k):
+            cur = fold_once(cur, sub, tables[fold_round])
+            fold_round += 1
+            sub = ext_f.sqr_s(sub)
     # final interpolation over coset g^(2^R)·H_{N>>R}
     n_fin = N >> num_folds
     shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
@@ -134,40 +174,51 @@ def fri_prove(codeword, transcript, config, base_degree: int) -> FriOracles:
 
 
 def fri_verify_queries(
-    proof_fri, challenges, final_monomials, query_index: int, query_data,
-    log_full: int, num_folds: int,
+    schedule, challenges, final_monomials, query_index: int, leaves,
+    log_full: int,
 ):
-    """Check one query's fold chain on host (python ints).
+    """Check one query's grouped fold chain on host (python ints).
 
-    query_data: list over rounds of (pair_values) where pair_values =
-    [(c0,c1) at even idx, (c0,c1) at odd idx] for the round's pair containing
-    the query. Returns True iff the chain folds into the final polynomial.
+    schedule: per-oracle fold counts; challenges: the one drawn ext
+    challenge per oracle; leaves: per oracle, the 2^k ext values of the
+    Merkle leaf covering the query (brev-consecutive domain points).
+    Returns True iff the chain folds into the final polynomial.
     """
     idx = query_index
-    cur_pair_expected = None
-    for r in range(num_folds):
-        log_nr = log_full - r
-        pair = query_data[r]
-        even, odd = pair
-        if cur_pair_expected is not None:
-            mine = even if (idx & 1) == 0 else odd
-            if tuple(mine) != tuple(cur_pair_expected):
-                return False
-        # fold
-        k = idx >> 1
-        shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << r)
-        n_r = 1 << log_nr
-        # x at brev position 2k: natural index brev(2k)
-        nat = _brev(2 * k, log_nr)
-        x = gl.mul(shift, gl.pow_(gl.omega(log_nr), nat))
+    fold_round = 0
+    cur_expected = None
+    for r, k in enumerate(schedule):
+        block = 1 << k
+        sub_idx = idx % block
+        leaf_idx = idx >> k
+        vals = [tuple(v) for v in leaves[r]]
+        if len(vals) != block:
+            return False
+        if cur_expected is not None and vals[sub_idx] != tuple(cur_expected):
+            return False
+        # fold the whole leaf down with ch, ch^2, ch^4, ...
         ch = challenges[r]
-        s = ext_f.add_s(even, odd)
-        d = ext_f.sub_s(even, odd)
-        dox = ext_f.mul_by_base_s(d, gl.inv(x))
-        t = ext_f.add_s(s, ext_f.mul_s(dox, ch))
-        cur_pair_expected = ext_f.mul_by_base_s(t, INV2)
-        idx = k
+        base_global = leaf_idx * block
+        for j in range(k):
+            log_nr = log_full - fold_round
+            shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << fold_round)
+            nxt = []
+            for m in range(len(vals) // 2):
+                gi = (base_global >> j) + 2 * m
+                x = gl.mul(shift, gl.pow_(gl.omega(log_nr), _brev(gi, log_nr)))
+                even, odd = vals[2 * m], vals[2 * m + 1]
+                s = ext_f.add_s(even, odd)
+                d = ext_f.sub_s(even, odd)
+                dox = ext_f.mul_by_base_s(d, gl.inv(x))
+                t = ext_f.add_s(s, ext_f.mul_s(dox, ch))
+                nxt.append(ext_f.mul_by_base_s(t, INV2))
+            vals = nxt
+            fold_round += 1
+            ch = ext_f.sqr_s(ch)
+        cur_expected = vals[0]
+        idx = leaf_idx
     # final check: evaluate final monomials at the folded domain point
+    num_folds = sum(schedule)
     log_fin = log_full - num_folds
     nat = _brev(idx, log_fin)
     shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds)
@@ -177,7 +228,7 @@ def fri_verify_queries(
     for c in final_monomials:
         acc = ext_f.add_s(acc, ext_f.mul_s(c, xp))
         xp = ext_f.mul_by_base_s(xp, x)
-    return tuple(acc) == tuple(cur_pair_expected)
+    return tuple(acc) == tuple(cur_expected)
 
 
 def _brev(i: int, bits: int) -> int:

@@ -287,7 +287,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     alpha_pows = AlphaPows(alpha, total_alpha_terms)
     acc = gate_terms_contribution(
         assembly, setup.selector_paths, copy_lde_flat[:Cg], gate_wit_lde,
-        const_lde_flat, setup.selector_depth, alpha_pows, (N,),
+        const_lde_flat, alpha_pows, (N,),
     )
     cp_acc = copy_permutation_quotient_terms(
         z_lde, z_shift_lde, partial_ldes, chunks, copy_lde_flat,
@@ -466,28 +466,34 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     fri_qs_per_round = []
     fidxs = np.array(idxs, dtype=np.int64)
     for r, tree in enumerate(fri.trees):
-        pairs = fidxs >> 1
+        k = fri.schedule[r]
+        block = 1 << k
+        leaf_idx = fidxs >> k
         v0, v1 = fri.values[r]
-        # one gather for the round: rows = [ev0, od0, ev1, od1] stacked
-        pair_dev = jnp.asarray(np.concatenate([2 * pairs, 2 * pairs + 1]))
+        # one gather per oracle: every query's whole 2^k-point leaf
+        rows = (
+            leaf_idx[:, None] * block + np.arange(block)[None, :]
+        ).reshape(-1)
+        rows_dev = jnp.asarray(rows)
         gathered = np.asarray(
-            jnp.stack([v0[pair_dev], v1[pair_dev]])
-        )  # (2, 2Q): [c0|c1] x [evens|odds]
+            jnp.stack([v0[rows_dev], v1[rows_dev]])
+        )  # (2, Q*block)
         Q = len(idxs)
-        paths = tree.get_proofs([int(p) for p in pairs])
+        paths = tree.get_proofs([int(p) for p in leaf_idx])
         fri_qs_per_round.append(
             [
                 OracleQuery(
                     leaf_values=[
-                        int(gathered[0, q]), int(gathered[1, q]),
-                        int(gathered[0, Q + q]), int(gathered[1, Q + q]),
+                        int(gathered[c, q * block + j])
+                        for j in range(block)
+                        for c in (0, 1)
                     ],
                     path=paths[q],
                 )
                 for q in range(Q)
             ]
         )
-        fidxs = pairs
+        fidxs = leaf_idx
     queries = [
         SingleRoundQueries(
             witness=wit_qs[q],

@@ -26,13 +26,29 @@ from ..merkle import MerkleTreeWithCap
 from ..ntt import lde_from_monomial, monomial_from_values
 
 
-def build_selector_paths(gates) -> list[list[int]]:
-    """Balanced binary tree over gate ids; path = LSB-first bit list."""
-    k = len(gates)
-    if k == 1:
-        return [[]]  # single gate: selector constantly 1
-    depth = (k - 1).bit_length()
-    return [[(i >> b) & 1 for b in range(depth)] for i in range(k)]
+def build_selector_tree(gates):
+    """Degree-aware selector placement (reference setup.rs:486 TreeNode
+    optimizer): high-degree / constant-hungry gates get short selector
+    paths. Returns (tree, per-gate paths as 0/1 lists)."""
+    from ..cs.selector_tree import GateDescription, compute_selector_placement
+
+    descriptions = [
+        GateDescription(
+            gate_idx=i,
+            num_constants=g.num_constants,
+            degree=g.max_degree,
+            needs_selector=True,
+            is_lookup=False,
+        )
+        for i, g in enumerate(gates)
+    ]
+    tree = compute_selector_placement(descriptions)
+    paths = []
+    for i in range(len(gates)):
+        p = tree.output_placement(i)
+        assert p is not None, f"gate {i} missing from selector tree"
+        paths.append([int(b) for b in p])
+    return tree, paths
 
 
 def non_residues_for_copy_permutation(num_cols: int) -> list[int]:
@@ -91,25 +107,35 @@ def compute_sigma_values(copy_placement: np.ndarray, trace_len: int):
 
 
 def build_constant_columns(assembly, selector_paths) -> np.ndarray:
-    """(K, n) uint64: selector path bits then per-gate constants."""
+    """(K, n) uint64 constant columns with variable-depth selector layout:
+    on a row holding gate g, columns [0, len(path_g)) carry g's selector
+    path bits and g's own constants start at column len(path_g) (reference
+    create_constant_setup_polys, setup.rs:710)."""
     n = assembly.trace_len
     K = assembly.geometry.num_constant_columns
-    depth = max((len(p) for p in selector_paths), default=0)
-    max_consts = max((g.num_constants for g in assembly.gates), default=0)
-    assert depth + max_consts <= K, (
-        f"selector depth {depth} + gate constants {max_consts} exceed "
-        f"{K} constant columns"
-    )
+    for gid, g in enumerate(assembly.gates):
+        used = len(selector_paths[gid]) + g.num_constants
+        assert used <= K, (
+            f"gate {g.name}: selector depth {len(selector_paths[gid])} + "
+            f"constants {g.num_constants} exceed {K} constant columns"
+        )
     cols = np.zeros((K, n), dtype=np.uint64)
-    paths = np.array(
-        [p + [0] * (depth - len(p)) for p in selector_paths], dtype=np.uint64
-    ).reshape(len(selector_paths), max(depth, 1) if depth else 0)
     rg = assembly.row_gate
-    if depth:
-        cols[:depth, :] = paths[rg].T
+    max_depth = max((len(p) for p in selector_paths), default=0)
+    if max_depth:
+        # bits[g, d] = path bit (rows of shallower gates keep zeros beyond
+        # their own path, which their selector product never reads)
+        bits = np.zeros((len(selector_paths), max_depth), dtype=np.uint64)
+        for gid, p in enumerate(selector_paths):
+            bits[gid, : len(p)] = p
+        cols[:max_depth, :] = bits[rg].T
+    offsets = np.array(
+        [len(p) for p in selector_paths], dtype=np.int64
+    )
     for row, consts in assembly.gate_constants.items():
+        off = int(offsets[rg[row]])
         for i, c in enumerate(consts):
-            cols[depth + i, row] = c
+            cols[off + i, row] = c
     return cols
 
 
@@ -132,6 +158,7 @@ class VerificationKey:
     num_wit_cols: int
     lookup_params: object = None
     num_lookup_tables: int = 0
+    fri_folding_schedule: list | None = None
 
     def to_dict(self):
         from dataclasses import asdict
@@ -150,6 +177,11 @@ class VerificationKey:
             "num_copy_cols": self.num_copy_cols,
             "num_wit_cols": self.num_wit_cols,
             "num_lookup_tables": self.num_lookup_tables,
+            "fri_folding_schedule": (
+                None
+                if self.fri_folding_schedule is None
+                else list(self.fri_folding_schedule)
+            ),
             "lookup_params": None
             if self.lookup_params is None
             else {
@@ -180,7 +212,6 @@ class SetupData:
     setup_tree: MerkleTreeWithCap
     selector_paths: list
     non_residues: list
-    selector_depth: int
 
 
 def generate_setup(assembly, config) -> SetupData:
@@ -193,16 +224,19 @@ def generate_setup(assembly, config) -> SetupData:
     assert config.fri_final_degree < n, (
         "fri_final_degree must be below the trace length (at least one fold)"
     )
-    selector_paths = build_selector_paths(assembly.gates)
-    # masked-constraint degree must fit the quotient LDE domain:
-    # (selector depth + gate degree) * (n-1) <= L*n - 1, conservatively
-    # depth + max_degree <= L; same cap for copy-permutation chunk relations.
-    depth_chk = max((len(p) for p in selector_paths), default=0)
-    for g in assembly.gates:
-        assert depth_chk + g.max_degree <= config.fri_lde_factor, (
-            f"gate {g.name}: selector depth {depth_chk} + degree "
-            f"{g.max_degree} exceeds fri_lde_factor {config.fri_lde_factor}"
-        )
+    tree, selector_paths = build_selector_tree(assembly.gates)
+    # masked-constraint degree must fit the quotient LDE domain: per-gate
+    # (own selector depth + gate degree) <= L — the degree-aware tree keeps
+    # high-degree gates shallow so this is tight, not worst-case.
+    tree_degree, tree_constants = tree.compute_stats()
+    assert tree_degree <= config.fri_lde_factor, (
+        f"selector tree degree {tree_degree} exceeds fri_lde_factor "
+        f"{config.fri_lde_factor}"
+    )
+    assert tree_constants <= assembly.geometry.num_constant_columns, (
+        f"selector tree needs {tree_constants} constant columns, geometry "
+        f"has {assembly.geometry.num_constant_columns}"
+    )
     assert (
         assembly.geometry.max_allowed_constraint_degree + 1
         <= config.fri_lde_factor
@@ -226,7 +260,6 @@ def generate_setup(assembly, config) -> SetupData:
     lde = lde_from_monomial(monomials, config.fri_lde_factor)
     leaves = lde.reshape(lde.shape[0], -1).T  # (lde*n, C+K)
     tree = MerkleTreeWithCap(leaves, config.merkle_tree_cap_size)
-    depth = max((len(p) for p in selector_paths), default=0)
     vk = VerificationKey(
         geometry=assembly.geometry,
         trace_len=n,
@@ -243,6 +276,7 @@ def generate_setup(assembly, config) -> SetupData:
         num_wit_cols=assembly.wit_placement.shape[0],
         lookup_params=assembly.lookup_params if assembly.lookups_enabled else None,
         num_lookup_tables=len(assembly.lookup_tables),
+        fri_folding_schedule=getattr(config, "fri_folding_schedule", None),
     )
     return SetupData(
         vk=vk,
@@ -253,5 +287,4 @@ def generate_setup(assembly, config) -> SetupData:
         setup_tree=tree,
         selector_paths=selector_paths,
         non_residues=non_residues_for_copy_permutation(sigma.shape[0]),
-        selector_depth=depth,
     )

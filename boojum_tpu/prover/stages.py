@@ -233,7 +233,7 @@ def num_gate_sweep_terms(assembly) -> int:
 
 def gate_terms_contribution(
     assembly, selector_paths, copy_lde_flat, wit_lde_flat, const_lde_flat,
-    selector_depth, alpha_pows: AlphaPows, domain_shape,
+    alpha_pows: AlphaPows, domain_shape,
 ):
     """Sum over gates/instances/terms of alpha^t * selector_g * term.
 
@@ -248,13 +248,13 @@ def gate_terms_contribution(
     if fn is None:
         fn = _build_gate_sweep(
             tuple(assembly.gates), tuple(tuple(p) for p in selector_paths),
-            assembly.geometry, selector_depth,
+            assembly.geometry,
         )
         assembly._gate_sweep_jit = fn
     return fn(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1)
 
 
-def _build_gate_sweep(gates, selector_paths, geometry, selector_depth):
+def _build_gate_sweep(gates, selector_paths, geometry):
     def core(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1):
         t = 0
         acc = None
@@ -271,7 +271,9 @@ def _build_gate_sweep(gates, selector_paths, geometry, selector_depth):
                     const_lde_flat,
                     inst * gate.principal_width,
                     inst * gate.witness_width,
-                    selector_depth,
+                    # variable-depth selectors: a gate's constants start
+                    # right after ITS OWN path bits
+                    len(selector_paths[gid]),
                 )
                 dst = TermsCollector()
                 gate.evaluate(ArrayOps, row, dst)

@@ -107,21 +107,20 @@ def verify(vk, proof, gates) -> bool:
         t.witness_field_elements(v)
     deep_ch = t.get_ext_challenge()
     # FRI replay — ALL security parameters come from the VK, never the proof
-    final_degree = vk.fri_final_degree
-    deg = n
-    num_folds = 0
-    while deg > final_degree:
-        deg //= 2
-        num_folds += 1
-    if num_folds < 1:
-        # fri_prove refuses zero-fold schedules; mirror that as a rejection
+    from .fri import fold_schedule
+
+    try:
+        schedule = fold_schedule(
+            n, vk.fri_final_degree, getattr(vk, "fri_folding_schedule", None)
+        )
+    except AssertionError:
         return False
-    if len(proof.fri_caps) != num_folds:
+    num_folds = sum(schedule)
+    if len(proof.fri_caps) != len(schedule):
         return False
     fri_challenges = []
-    for r in range(num_folds):
-        if r < len(proof.fri_caps):
-            t.witness_merkle_tree_cap(proof.fri_caps[r])
+    for r in range(len(schedule)):
+        t.witness_merkle_tree_cap(proof.fri_caps[r])
         fri_challenges.append(t.get_ext_challenge())
     if len(proof.final_fri_monomials) != (n >> num_folds):
         return False
@@ -148,13 +147,12 @@ def verify(vk, proof, gates) -> bool:
         for b, bit in enumerate(path):
             cb = const_vals[b]
             sel = ext_f.mul_s(sel, cb if bit else ext_f.sub_s((1, 0), cb))
-        depth = max(len(p) for p in vk.selector_paths)
         reps = gate.num_repetitions(geometry)
         gate_acc = ExtScalarOps.zero()
         for inst in range(reps):
             row = _ZRowView(
                 wit_vals, const_vals, inst * gate.principal_width,
-                inst * gate.witness_width, depth, Ct,
+                inst * gate.witness_width, len(path), Ct,
             )
             dst = TermsCollector()
             gate.evaluate(ExtScalarOps, row, dst)
@@ -326,29 +324,34 @@ def verify(vk, proof, gates) -> bool:
             diff = gl.sub(q.witness.leaf_values[col], proof.public_inputs[k])
             tb = gl.mul(diff, gl.inv(gl.sub(x, pt)))
             h = ext_f.add_s(h, ext_f.mul_by_base_s(ch, tb))
-        # FRI chain
-        if len(q.fri) != num_folds:
+        # FRI chain (grouped oracles per the folding schedule)
+        if len(q.fri) != len(schedule):
             return False
-        pairs = []
+        leaves = []
         fidx = idx
-        for r, oq in enumerate(q.fri):
-            pair_idx = fidx >> 1
+        for r, (k, oq) in enumerate(zip(schedule, q.fri)):
+            block = 1 << k
+            leaf_idx = fidx >> k
+            if len(oq.leaf_values) != 2 * block:
+                return False
             if not verify_proof_over_cap(
-                oq.leaf_values, oq.path, proof.fri_caps[r], pair_idx
+                oq.leaf_values, oq.path, proof.fri_caps[r], leaf_idx
             ):
                 return False
-            even = (oq.leaf_values[0], oq.leaf_values[1])
-            odd = (oq.leaf_values[2], oq.leaf_values[3])
-            pairs.append((even, odd))
-            fidx >>= 1
+            leaves.append(
+                [
+                    (oq.leaf_values[2 * j], oq.leaf_values[2 * j + 1])
+                    for j in range(block)
+                ]
+            )
+            fidx = leaf_idx
         # base oracle value must equal recomputed h
-        base_even, base_odd = pairs[0]
-        mine = base_even if (idx & 1) == 0 else base_odd
-        if tuple(mine) != tuple(h):
+        if tuple(leaves[0][idx % (1 << schedule[0])]) != tuple(h):
             return False
         if not fri_verify_queries(
-            None, fri_challenges, [tuple(c) for c in proof.final_fri_monomials],
-            idx, pairs, log_full, num_folds,
+            schedule, fri_challenges,
+            [tuple(c) for c in proof.final_fri_monomials],
+            idx, leaves, log_full,
         ):
             return False
     return True

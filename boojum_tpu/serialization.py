@@ -47,6 +47,7 @@ def vk_from_json(s: str) -> VerificationKey:
         num_wit_cols=int(d["num_wit_cols"]),
         lookup_params=lookup_params,
         num_lookup_tables=int(d.get("num_lookup_tables", 0)),
+        fri_folding_schedule=d.get("fri_folding_schedule"),
     )
 
 
@@ -64,7 +65,6 @@ def save_setup(path: str, setup: SetupData):
         "vk_json": np.frombuffer(
             vk_to_json(setup.vk).encode(), dtype=np.uint8
         ),
-        "selector_depth": np.asarray([setup.selector_depth], dtype=np.int64),
         "tree_num_layers": np.asarray(
             [len(setup.setup_tree.layers)], dtype=np.int64
         ),
@@ -95,5 +95,4 @@ def load_setup(path: str) -> SetupData:
             setup_tree=tree,
             selector_paths=vk.selector_paths,
             non_residues=[int(v) for v in z["non_residues"]],
-            selector_depth=int(z["selector_depth"][0]),
         )

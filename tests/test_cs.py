@@ -207,3 +207,57 @@ def test_native_resolver_poison_on_failed_batch():
         r.wait_till_resolved()
     with pytest.raises(RuntimeError, match="native"):
         r.values_flat(3)
+
+
+def test_resolution_record_playback():
+    """Record/playback of the witness-resolution order (reference
+    mt/sorters/sorter_playback.rs): a recorded live run replayed through
+    PlaybackResolver reproduces the identical witness with zero dependency
+    tracking, and diverging synthesis is detected."""
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.gates import FmaGate, ZeroCheckGate
+    from boojum_tpu.dag.resolver import PlaybackResolver, WitnessResolver
+
+    geom = CSGeometry(
+        num_columns_under_copy_permutation=8,
+        num_witness_columns=0,
+        num_constant_columns=6,
+        max_allowed_constraint_degree=4,
+    )
+
+    def synthesize(cs):
+        a = cs.alloc_variable_with_value(3)
+        b = cs.alloc_variable_with_value(5)
+        for _ in range(20):
+            a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+        flag = ZeroCheckGate.is_zero(cs, b)
+        return FmaGate.fma(cs, b, b, flag, 1, 1)
+
+    rec_resolver = WitnessResolver()
+    rec_resolver.start_recording()
+    cs1 = ConstraintSystem(geom, 1 << 10, resolver=rec_resolver)
+    out1 = synthesize(cs1)
+    asm1 = cs1.into_assembly()  # padding resolutions are part of the record
+    record = rec_resolver.resolution_record()
+    assert record, "live run must record resolutions"
+
+    cs2 = ConstraintSystem(
+        geom, 1 << 10, resolver=PlaybackResolver(record)
+    )
+    out2 = synthesize(cs2)
+    assert cs2.get_value(out2) == cs1.get_value(out1)
+    asm2 = cs2.into_assembly()
+    import numpy as np
+
+    np.testing.assert_array_equal(asm1.copy_cols_values, asm2.copy_cols_values)
+
+    # diverging synthesis (extra resolutions) must be detected
+    cs3 = ConstraintSystem(geom, 1 << 10, resolver=PlaybackResolver(record))
+    synthesize(cs3)
+    cs3.alloc_variable_with_value(7)
+    synthesize(cs3)  # registers resolutions beyond the record
+    import pytest
+
+    with pytest.raises(RuntimeError, match="playback divergence"):
+        cs3.resolver.wait_till_resolved()

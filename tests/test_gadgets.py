@@ -83,3 +83,52 @@ def test_uint_ops():
     assert [x.get_value(cs) for x in le] == [0xEF, 0xBE, 0xAD, 0xDE]
     asm = cs.into_assembly()
     assert check_if_satisfied(asm, verbose=True)
+
+
+def test_var_length_encodable():
+    """CSVarLengthEncodable analog: deterministic field-recursive flattening
+    to a variable list; pushing an encoded gadget through a commitment queue
+    round-trips (reference cs_derive var_length_encodable)."""
+    from dataclasses import dataclass
+
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.gadgets.derive import derive_gadget, encode_variables
+    from boojum_tpu.gadgets.num import Num
+    from boojum_tpu.gadgets.boolean import Boolean
+    from boojum_tpu.gadgets.queue import CircuitQueue
+
+    @derive_gadget
+    @dataclass
+    class Inner:
+        a: Num
+        flag: Boolean
+
+    @derive_gadget
+    @dataclass
+    class Outer:
+        p: Inner
+        b: Num
+
+    # queue hashing uses the 130-column flattened Poseidon2 gate
+    geom = CSGeometry(
+        num_columns_under_copy_permutation=130,
+        num_witness_columns=0,
+        num_constant_columns=8,
+        max_allowed_constraint_degree=7,
+    )
+    cs = ConstraintSystem(geom, 1 << 12)
+    o = Outer.allocate(cs, {"p": {"a": 7, "flag": 1}, "b": 9})
+    enc = o.encode_vars()
+    assert o.encoding_length() == 3 == len(enc)
+    assert [cs.get_value(v) for v in enc] == [7, 1, 9]
+    assert encode_variables([o, o]) == enc + enc
+
+    q = CircuitQueue(cs, element_width=o.encoding_length())
+    q.push(cs, enc)
+    popped = q.pop_front(cs)
+    q.enforce_consistency(cs)
+    assert [cs.get_value(v) for v in popped] == [7, 1, 9]
+    from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+    assert check_if_satisfied(cs.into_assembly())
