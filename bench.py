@@ -37,8 +37,11 @@ def build_sha256(num_bytes: int):
         num_constant_columns=8,
         max_allowed_constraint_degree=7,
     )
+    # capacity scales with the message: 8 kB fills a 2^16 trace, the
+    # north-star 128 kB fills 2^20 (reference sha256/mod.rs:269 scaling)
+    capacity = 1 << max(17, (num_bytes // 8192).bit_length() + 16)
     cs = ConstraintSystem(
-        geom, 1 << 17,
+        geom, capacity,
         lookup_params=LookupParameters(width=4, num_repetitions=8),
     )
     data = bytes(i % 255 for i in range(num_bytes))
@@ -51,11 +54,13 @@ def build_fma(log_n: int):
     from boojum_tpu.cs.types import CSGeometry
     from boojum_tpu.cs.gates import FmaGate, PublicInputGate
 
+    # degree-3 chunks keep every relation at degree <= 4, so the whole
+    # pipeline runs at LDE factor 4 (half the memory of the SHA geometry)
     geom = CSGeometry(
         num_columns_under_copy_permutation=16,
         num_witness_columns=0,
         num_constant_columns=6,
-        max_allowed_constraint_degree=4,
+        max_allowed_constraint_degree=3,
     )
     cs = ConstraintSystem(geom, 1 << log_n)
     a = cs.alloc_variable_with_value(1)
@@ -74,7 +79,7 @@ def main():
     circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
     reps = int(os.environ.get("BENCH_REPS", "1"))
     config = ProofConfig(
-        fri_lde_factor=8,
+        fri_lde_factor=8 if circuit == "sha256" else 4,
         merkle_tree_cap_size=16,
         num_queries=50,
         pow_bits=0,

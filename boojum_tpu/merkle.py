@@ -12,11 +12,46 @@ gathers from the stored device layers on host at query time (queries are rare:
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .hashes.poseidon2 import leaf_hash, node_hash, Poseidon2SpongeHost
+
+
+# Levels at or below this node count are fused into one compiled graph:
+# the tail of a tree is ~log2(N) tiny dispatches whose round-trip latency
+# dominates behind a network-tunneled device, while the big bottom levels
+# amortize their dispatch over real compute (and fusing THEM produced
+# modules too large for the remote compile service).
+_FUSE_THRESHOLD = 1 << 12
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _tree_tail_layers(digests, cap_size: int):
+    """All remaining (small) node layers in one compiled graph."""
+    layers = []
+    cur = digests
+    while cur.shape[0] > cap_size:
+        cur = node_hash(cur[0::2], cur[1::2])
+        layers.append(cur)
+    return tuple(layers)
+
+
+def _tree_layers(leaf_values, cap_size: int):
+    digests = leaf_hash(leaf_values)
+    layers = [digests]
+    while (
+        layers[-1].shape[0] > cap_size
+        and layers[-1].shape[0] > _FUSE_THRESHOLD
+    ):
+        cur = layers[-1]
+        layers.append(node_hash(cur[0::2], cur[1::2]))
+    if layers[-1].shape[0] > cap_size:
+        layers.extend(_tree_tail_layers(layers[-1], cap_size))
+    return tuple(layers)
 
 
 class MerkleTreeWithCap:
@@ -37,13 +72,10 @@ class MerkleTreeWithCap:
         assert self.num_leaves & (self.num_leaves - 1) == 0, "leaf count must be 2^k"
         assert self.num_leaves >= cap_size
         self.cap_size = cap_size
-        digests = leaf_hash(leaf_values)  # (N, 4)
-        layers = [digests]
-        while layers[-1].shape[0] > cap_size:
-            cur = layers[-1]
-            layers.append(node_hash(cur[0::2], cur[1::2]))
-        self.layers = layers
-        self._cap_host = [tuple(int(x) for x in row) for row in np.asarray(layers[-1])]
+        self.layers = list(_tree_layers(leaf_values, cap_size))
+        self._cap_host = [
+            tuple(int(x) for x in row) for row in np.asarray(self.layers[-1])
+        ]
 
     @classmethod
     def from_layers(cls, layers, cap_size: int) -> "MerkleTreeWithCap":

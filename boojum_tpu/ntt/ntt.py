@@ -144,7 +144,7 @@ def distribute_powers(a: jax.Array, base: int) -> jax.Array:
 
 
 @partial(jax.jit, static_argnums=(1, 2))
-def lde_from_monomial(
+def _lde_from_monomial_jit(
     coeffs: jax.Array,
     lde_factor: int,
     coset: int = gl.MULTIPLICATIVE_GENERATOR,
@@ -169,10 +169,79 @@ def lde_from_monomial(
     return fft_natural_to_bitreversed(scaled, ctx)
 
 
+def lde_from_monomial(
+    coeffs: jax.Array,
+    lde_factor: int,
+    coset: int = gl.MULTIPLICATIVE_GENERATOR,
+) -> jax.Array:
+    """Low-degree-extend monomial coeffs (..., n) -> (..., lde_factor, n).
+
+    Coset axis is indexed by bit-reversed coset index; each coset is the
+    bit-reversed evaluations over {coset*w_N*<w_n>}. Flattening the last two
+    axes gives the full LDE domain in bit-reversed enumeration. Large column
+    batches are processed in chunks to bound the transform's transient
+    memory (see monomial_from_values).
+    """
+    if coeffs.ndim < 2:
+        return _lde_from_monomial_jit(coeffs, lde_factor, coset)
+    B = coeffs.shape[0]
+    per = _col_chunks(B, coeffs.size // B * 8 * lde_factor)
+    if per is None:
+        return _lde_from_monomial_jit(coeffs, lde_factor, coset)
+    n = coeffs.shape[-1]
+    return _assemble_chunks(
+        coeffs.shape[:-1] + (lde_factor, n),
+        lambda i: _lde_from_monomial_jit(coeffs[i : i + per], lde_factor, coset),
+        range(0, B, per),
+    )
+
+
 @jax.jit
-def monomial_from_values(values: jax.Array) -> jax.Array:
-    """Values over H (natural order) -> monomial coefficients."""
+def _monomial_from_values_jit(values: jax.Array) -> jax.Array:
     return ifft_natural_to_natural(values)
+
+
+# The unrolled radix-2 stages keep O(log n) live stage buffers; chunk big
+# column batches so the transient peak stays bounded (the 2^20-row traces
+# OOM'd 16 GB HBM inside one monolithic (B, L, n) transform otherwise).
+_NTT_CHUNK_BUDGET = 128 << 20  # bytes of INPUT columns per chunk
+
+
+def _col_chunks(total_cols: int, bytes_per_col: int):
+    per = max(1, _NTT_CHUNK_BUDGET // max(bytes_per_col, 1))
+    if per >= total_cols:
+        return None
+    return per
+
+
+def _assemble_chunks(shape, produce, starts):
+    """Write per-chunk results into a donated output buffer in place (a
+    concatenate would transiently double the multi-GB footprint)."""
+    out = jnp.zeros(shape, jnp.uint64)
+    for i in starts:
+        out = _write_block(out, produce(i), i)
+    return out
+
+
+@partial(jax.jit, donate_argnums=(0,), static_argnums=(2,))
+def _write_block(buf, chunk, i: int):
+    return jax.lax.dynamic_update_slice_in_dim(buf, chunk, i, axis=0)
+
+
+def monomial_from_values(values: jax.Array) -> jax.Array:
+    """Values over H (natural order) -> monomial coefficients (column
+    batches chunked to bound transient memory)."""
+    if values.ndim < 2:
+        return _monomial_from_values_jit(values)
+    B = values.shape[0]
+    per = _col_chunks(B, values.size // B * 8)
+    if per is None:
+        return _monomial_from_values_jit(values)
+    return _assemble_chunks(
+        values.shape,
+        lambda i: _monomial_from_values_jit(values[i : i + per]),
+        range(0, B, per),
+    )
 
 
 @jax.jit
@@ -199,20 +268,29 @@ def eval_monomial_at_ext_point(coeffs: jax.Array, z, z_pows=None):
     return _eval_with_pows(coeffs, z_pows[0], z_pows[1])
 
 
+@partial(jax.jit, static_argnums=(1,))
+def _ext_powers_jit(z01, count: int):
+    """Log-doubling power table built in ONE compiled graph (the eager
+    version dispatched log2(count) growing-array ops per call — behind a
+    network-tunneled device those round-trips dominated)."""
+    p0 = jnp.ones((1,), jnp.uint64)
+    p1 = jnp.zeros((1,), jnp.uint64)
+    step = (z01[0], z01[1])  # z^cur, maintained by squaring
+    cur = 1
+    while cur < count:
+        n0, n1 = ext.mul((p0, p1), step)
+        p0 = jnp.concatenate([p0, n0])
+        p1 = jnp.concatenate([p1, n1])
+        step = ext.mul(step, step)
+        cur *= 2
+    return (p0, p1)
+
+
 def ext_powers_device(z, count: int):
     """Powers [1, z, ..., z^(count-1)] of an ext scalar, as pair of arrays."""
     assert count & (count - 1) == 0
-    p0 = jnp.asarray(np.array([1], dtype=np.uint64))
-    p1 = jnp.asarray(np.array([0], dtype=np.uint64))
-    cur = 1
-    zc = (int(z[0]), int(z[1]))
-    while cur < count:
-        step = ext.pow_s(zc, cur)
-        n0, n1 = ext.mul((p0, p1), (jnp.uint64(step[0]), jnp.uint64(step[1])))
-        p0 = jnp.concatenate([p0, n0])
-        p1 = jnp.concatenate([p1, n1])
-        cur *= 2
-    return (p0, p1)
+    z01 = jnp.asarray(np.array([int(z[0]), int(z[1])], dtype=np.uint64))
+    return _ext_powers_jit(z01, count)
 
 
 def _modsum(a: jax.Array) -> jax.Array:

@@ -33,6 +33,10 @@ from .stages import ext_scalar
 INV2 = (gl.P + 1) // 2
 
 
+from functools import lru_cache
+
+
+@lru_cache(maxsize=4)
 def fold_challenge_tables(log_full: int, num_rounds: int):
     """Per-round inverse-x tables: round r domain is the coset
     g^(2^r)·H_{N>>r}; table r holds 1/x at pair positions (even bit-reversed

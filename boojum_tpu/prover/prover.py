@@ -77,23 +77,57 @@ from .stages import (
 )
 
 
+def _modsum_axis0(a):
+    """Modular sum along axis 0 (the ntt log-depth fold, axis-moved)."""
+    from ..ntt.ntt import _modsum
+
+    return _modsum(jnp.moveaxis(a, 0, -1))
+
+
 @jax.jit
-def _deep_main_sum(all_lde_flat, y0s, y1s, c0s, c1s, inv_xz):
-    """Σ_i ch_i·(f_i − y_i)/(x − z) over all opened columns, as one scan
-    (keeps memory O(N) while compiling to a single graph)."""
-
-    def body(h, inputs):
-        f, y0, y1, c0, c1 = inputs
-        num = (gf.sub(f, y0), gf.neg(jnp.broadcast_to(y1, f.shape)))
-        term = ext_f.mul(ext_f.mul(num, inv_xz), (c0, c1))
-        return (gf.add(h[0], term[0]), gf.add(h[1], term[1])), None
-
-    init = (
-        jnp.zeros_like(all_lde_flat[0]),
-        jnp.zeros_like(all_lde_flat[0]),
+def _deep_block(block_lde, c0s, c1s):
+    return (
+        _modsum_axis0(gf.mul(block_lde, c0s[:, None])),
+        _modsum_axis0(gf.mul(block_lde, c1s[:, None])),
     )
-    h, _ = jax.lax.scan(body, init, (all_lde_flat, y0s, y1s, c0s, c1s))
-    return h
+
+
+@jax.jit
+def _deep_combine(t0, t1, y0s, y1s, c0s, c1s, inv_xz):
+    s = ext_f.mul((c0s, c1s), (y0s, y1s))
+    num = (gf.sub(t0, _modsum_axis0(s[0])), gf.sub(t1, _modsum_axis0(s[1])))
+    return ext_f.mul(num, inv_xz)
+
+
+_DEEP_BLOCK_BUDGET = 128 << 20  # bytes of columns per contraction block
+
+
+def _deep_main_sum(lde_sources, y0s, y1s, c0s, c1s, inv_xz):
+    """Σ_i ch_i·(f_i − y_i)/(x − z) over all opened columns.
+
+    `lde_sources` is a list of (B_k, N) arrays consumed in order (witness,
+    setup, stage-2, quotient) — iterating them directly avoids materializing
+    their multi-GB concatenation. One batched contraction per column BLOCK:
+    Σ ch_i·f_i is two base-field log-tree reductions (fully parallel on the
+    VPU; the sequential lax.scan this replaced serialized B device steps and
+    dominated round 5), and the blocks bound the transient (columns x
+    domain) product that OOM'd 2^20-row traces when materialized whole."""
+    N = lde_sources[0].shape[-1]
+    per = max(1, _DEEP_BLOCK_BUDGET // (N * 8))
+    t0 = None
+    t1 = None
+    off = 0
+    for src in lde_sources:
+        B = src.shape[0]
+        for i in range(0, B, per):
+            j = min(i + per, B)
+            b0, b1 = _deep_block(
+                src[i:j], c0s[off + i : off + j], c1s[off + i : off + j]
+            )
+            t0 = b0 if t0 is None else gf.add(t0, b0)
+            t1 = b1 if t1 is None else gf.add(t1, b1)
+        off += B
+    return _deep_combine(t0, t1, y0s, y1s, c0s, c1s, inv_xz)
 
 
 def _commit_columns(lde, cap_size):
@@ -109,8 +143,30 @@ def _commit_columns(lde, cap_size):
     return MerkleTreeWithCap(leaves, cap_size), leaves
 
 
+from functools import lru_cache
+
+
+def clear_domain_caches():
+    """Drop the cached per-geometry device tables (challenge-independent
+    LDE-domain constants). They pin a few full-domain buffers per geometry;
+    long-lived processes switching between large geometries can reclaim the
+    HBM here."""
+    from .fri import fold_challenge_tables
+
+    for fn in (
+        _domain_xs_brev,
+        _l0_brev,
+        _inv_xs_brev,
+        _vanishing_inv_brev,
+        fold_challenge_tables,
+    ):
+        fn.cache_clear()
+
+
+@lru_cache(maxsize=4)
 def _domain_xs_brev(log_n, lde_factor):
-    """Full LDE domain values g·w_N^i in bit-reversed enumeration."""
+    """Full LDE domain values g·w_N^i in bit-reversed enumeration (cached:
+    identical across proves of the same geometry)."""
     log_full = log_n + (lde_factor.bit_length() - 1)
     N = 1 << log_full
     xs = powers_device(gl.omega(log_full), N)
@@ -118,6 +174,47 @@ def _domain_xs_brev(log_n, lde_factor):
     return xs[jnp.asarray(bitreverse_indices(log_full))]
 
 
+@lru_cache(maxsize=4)
+def _l0_brev(log_n, lde_factor):
+    """L_0(x) = (x^n - 1) / (n (x - 1)) over the LDE domain, brev order
+    (cached: challenge-independent)."""
+    n = 1 << log_n
+    log_full = log_n + (lde_factor.bit_length() - 1)
+    xs_lde = _domain_xs_brev(log_n, lde_factor)
+    zh = gf.sub(
+        jnp.repeat(
+            jnp.asarray(
+                np.array(
+                    [
+                        gl.pow_(
+                            gl.mul(
+                                gl.MULTIPLICATIVE_GENERATOR,
+                                gl.pow_(gl.omega(log_full), int(jb)),
+                            ),
+                            n,
+                        )
+                        for jb in bitreverse_indices(lde_factor.bit_length() - 1)
+                    ],
+                    dtype=np.uint64,
+                )
+            ),
+            n,
+        ),
+        jnp.uint64(1),
+    )
+    return gf.mul(
+        gf.mul(zh, jnp.uint64(gl.inv(n))),
+        gf.batch_inverse(gf.sub(xs_lde, jnp.uint64(1))),
+    )
+
+
+@lru_cache(maxsize=4)
+def _inv_xs_brev(log_n, lde_factor):
+    """1/x over the LDE domain, brev order (cached: challenge-independent)."""
+    return gf.batch_inverse(_domain_xs_brev(log_n, lde_factor))
+
+
+@lru_cache(maxsize=4)
 def _vanishing_inv_brev(log_n, lde_factor):
     """1/(x^n - 1) over the LDE domain (per-coset constants, brev order)."""
     n = 1 << log_n
@@ -238,32 +335,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     const_lde_flat = setup_lde_flat[Ct : Ct + K]
     table_lde_flat = setup_lde_flat[Ct + K :]
     xs_lde = _domain_xs_brev(log_n, L)
-    # L_0(x) = (x^n - 1) / (n (x - 1))
-    zh = gf.sub(
-        jnp.repeat(
-            jnp.asarray(
-                np.array(
-                    [
-                        gl.pow_(
-                            gl.mul(
-                                gl.MULTIPLICATIVE_GENERATOR,
-                                gl.pow_(gl.omega(log_full), int(jb)),
-                            ),
-                            n,
-                        )
-                        for jb in bitreverse_indices(L.bit_length() - 1)
-                    ],
-                    dtype=np.uint64,
-                )
-            ),
-            n,
-        ),
-        jnp.uint64(1),
-    )
-    l0 = gf.mul(
-        gf.mul(zh, jnp.uint64(gl.inv(n))),
-        gf.batch_inverse(gf.sub(xs_lde, jnp.uint64(1))),
-    )
+    l0 = _l0_brev(log_n, L)
     s2_lde_flat = s2_lde.reshape(-1, N)
     z_lde = (s2_lde_flat[0], s2_lde_flat[1])
     omega = gl.omega(log_n)
@@ -366,16 +438,12 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
     clock.start("round5_deep_fri")
-    all_lde_flat = shard_cols(
-        jnp.concatenate(
-            [
-                wit_lde_all,
-                setup_lde_flat,
-                s2_lde_flat,
-                q_lde.reshape(2 * L, N),
-            ]
-        )
-    )
+    deep_sources = [
+        wit_lde_all,
+        setup_lde_flat,
+        s2_lde_flat,
+        q_lde.reshape(2 * L, N),
+    ]
     # 1/(x - z), 1/(x - z*omega) over the domain (ext)
     x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),
                  jnp.broadcast_to(jnp.uint64(gl.neg(z_chal[1])), xs_lde.shape))
@@ -397,7 +465,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     y1s = jnp.asarray(
         np.array([v[1] for v in values_at_z], dtype=np.uint64)
     )
-    h = _deep_main_sum(all_lde_flat, y0s, y1s, c0s, c1s, inv_xz)
+    h = _deep_main_sum(deep_sources, y0s, y1s, c0s, c1s, inv_xz)
     # z-poly at z*omega
     for i in range(2):
         c0, c1 = deep_pows.take(1)
@@ -411,7 +479,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         h = ext_f.add(h, term)
     # lookup A_i/B at 0: (f(x) - f(0)) / x with f as ext coordinate pair
     if lookups:
-        inv_x = gf.batch_inverse(xs_lde)
+        inv_x = _inv_xs_brev(log_n, L)
         ab_off = 2 + 2 * num_partials
         for i in range(lp.num_repetitions + 1):
             c0, c1 = deep_pows.take(1)

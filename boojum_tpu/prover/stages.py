@@ -47,37 +47,68 @@ def chunk_columns(num_cols: int, max_degree: int):
     return [list(range(i, min(i + cs, num_cols))) for i in range(0, num_cols, cs)]
 
 
-@jax.jit
-def _chunk_num_den(w_chunk, s_chunk, k_chunk, xs, b, g):
-    """Π over the chunk's columns of numerator (w + β·k·x + γ) and
-    denominator (w + β·σ + γ) — one small compiled graph, reused for every
-    chunk of the same width. The denominator inversion happens OUTSIDE this
-    jit: batch_inverse must stay a top-level jit boundary — inlining its
+@partial(jax.jit, static_argnums=(6,))
+def _all_chunk_num_den(copy_vals, sigma_vals, ks, xs, b, g, chunks):
+    """Per-chunk products of numerator (w + β·k·x + γ) and denominator
+    (w + β·σ + γ), ALL chunks in one compiled graph -> (num_chunks, n)
+    stacked ext pairs. The denominator inversion happens OUTSIDE this jit:
+    batch_inverse must stay a top-level jit boundary — inlining its
     Fermat-chain into larger XLA:CPU modules has produced never-terminating
     executables on this backend (miscompile class, not a slowness issue)."""
-    m = w_chunk.shape[0]
-    num_p = None
-    den_p = None
-    for j in range(m):
-        w = w_chunk[j]
-        kx = gf.mul(xs, k_chunk[j])
-        num = (
-            gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
-            gf.add(gf.mul(kx, b[1]), g[1]),
-        )
-        s = s_chunk[j]
-        den = (
-            gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
-            gf.add(gf.mul(s, b[1]), g[1]),
-        )
-        num_p = num if num_p is None else ext_f.mul(num_p, num)
-        den_p = den if den_p is None else ext_f.mul(den_p, den)
-    return num_p, den_p
+    nums0, nums1, dens0, dens1 = [], [], [], []
+    for chunk in chunks:
+        num_p = None
+        den_p = None
+        for col in chunk:
+            w = copy_vals[col]
+            kx = gf.mul(xs, ks[col])
+            num = (
+                gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
+                gf.add(gf.mul(kx, b[1]), g[1]),
+            )
+            s = sigma_vals[col]
+            den = (
+                gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
+                gf.add(gf.mul(s, b[1]), g[1]),
+            )
+            num_p = num if num_p is None else ext_f.mul(num_p, num)
+            den_p = den if den_p is None else ext_f.mul(den_p, den)
+        nums0.append(num_p[0])
+        nums1.append(num_p[1])
+        dens0.append(den_p[0])
+        dens1.append(den_p[1])
+    return (
+        (jnp.stack(nums0), jnp.stack(nums1)),
+        (jnp.stack(dens0), jnp.stack(dens1)),
+    )
 
 
-def _chunk_ratio(w_chunk, s_chunk, k_chunk, xs, b, g):
-    num_p, den_p = _chunk_num_den(w_chunk, s_chunk, k_chunk, xs, b, g)
-    return ext_f.mul(num_p, ext_f.batch_inverse(den_p))
+@jax.jit
+def _z_and_partials(num_all, den_inv_all):
+    """Chunk ratios -> full-row ratio -> exclusive prefix product z ->
+    cumulative partial products, one compiled graph. Inputs are
+    (num_chunks, n) stacked ext pairs (den already inverted)."""
+    K = num_all[0].shape[0]
+    ratios = ext_f.mul(num_all, den_inv_all)
+    full = (ratios[0][0], ratios[1][0])
+    for j in range(1, K):
+        full = ext_f.mul(full, (ratios[0][j], ratios[1][j]))
+    incl = _ext_prefix_prod(full)
+    one = jnp.ones((1,), jnp.uint64)
+    zero = jnp.zeros((1,), jnp.uint64)
+    z = (
+        jnp.concatenate([one, incl[0][:-1]]),
+        jnp.concatenate([zero, incl[1][:-1]]),
+    )
+    parts0, parts1 = [], []
+    acc = z
+    for j in range(K - 1):
+        acc = ext_f.mul(acc, (ratios[0][j], ratios[1][j]))
+        parts0.append(acc[0])
+        parts1.append(acc[1])
+    if parts0:
+        return z, (jnp.stack(parts0), jnp.stack(parts1))
+    return z, (jnp.zeros((0,) + z[0].shape, jnp.uint64),) * 2
 
 
 @jax.jit
@@ -119,32 +150,17 @@ def compute_copy_permutation_stage2(
     chunks = chunk_columns(C, max_degree)
     ks = jnp.asarray(np.array([int(k) for k in non_residues], dtype=np.uint64))
 
-    chunk_ratios = []
-    for chunk in chunks:
-        lo, hi = chunk[0], chunk[-1] + 1
-        chunk_ratios.append(
-            _chunk_ratio(
-                copy_vals[lo:hi], sigma_vals[lo:hi], ks[lo:hi], xs, b, g
-            )
-        )
-
-    full_ratio = chunk_ratios[0]
-    for r in chunk_ratios[1:]:
-        full_ratio = ext_f.mul(full_ratio, r)
-
-    incl = _ext_prefix_prod(full_ratio)
-    one = jnp.ones((1,), jnp.uint64)
-    zero = jnp.zeros((1,), jnp.uint64)
-    z = (
-        jnp.concatenate([one, incl[0][:-1]]),
-        jnp.concatenate([zero, incl[1][:-1]]),
+    num_all, den_all = _all_chunk_num_den(
+        copy_vals, sigma_vals, ks, xs, b, g,
+        tuple(tuple(c) for c in chunks),
     )
-    # partial products p_j = z * prod_{k<=j} chunk_ratio_k (pointwise row r)
-    partials = []
-    acc = z
-    for r in chunk_ratios[:-1]:
-        acc = ext_f.mul(acc, r)
-        partials.append(acc)
+    # ONE stacked inversion for every chunk denominator
+    den_inv_all = ext_f.batch_inverse(den_all)
+    z, partials_stacked = _z_and_partials(num_all, den_inv_all)
+    partials = [
+        (partials_stacked[0][j], partials_stacked[1][j])
+        for j in range(len(chunks) - 1)
+    ]
     return z, partials, chunks
 
 
@@ -332,13 +348,15 @@ def compute_lookup_polys(
     """
     b = ext_scalar(lookup_beta)
     g = ext_scalar(lookup_gamma)
+    R = int(num_repetitions)
     dens = _lookup_denominators(
-        lookup_cols, table_id_col, table_cols, b, g,
-        int(num_repetitions), int(width),
+        lookup_cols, table_id_col, table_cols, b, g, R, int(width),
     )
-    # invert at top-level jit boundaries (see _chunk_num_den)
-    a_polys = [ext_f.batch_inverse(d) for d in dens[:-1]]
-    t_inv = ext_f.batch_inverse(dens[-1])
+    # ONE stacked inversion for all R+1 denominators (batch_inverse stays a
+    # top-level jit boundary; see _all_chunk_num_den)
+    inv = ext_f.batch_inverse(dens)
+    a_polys = [(inv[0][i], inv[1][i]) for i in range(R)]
+    t_inv = (inv[0][R], inv[1][R])
     b_poly = (gf.mul(t_inv[0], multiplicities), gf.mul(t_inv[1], multiplicities))
     return a_polys, b_poly
 
@@ -347,6 +365,8 @@ def compute_lookup_polys(
 def _lookup_denominators(
     lookup_cols, table_id_col, table_cols, b, g, num_repetitions, width
 ):
+    """(R+1, n) stacked ext pairs: the R sub-argument denominators plus the
+    table denominator, ready for one batched inversion."""
     gpow = _ext_powers_traced(g, width + 1)
     dens = []
     for i in range(num_repetitions):
@@ -357,7 +377,10 @@ def _lookup_denominators(
             [table_cols[j] for j in range(width)], table_cols[width], gpow, b
         )
     )
-    return dens
+    return (
+        jnp.stack([d[0] for d in dens]),
+        jnp.stack([d[1] for d in dens]),
+    )
 
 
 def lookup_quotient_terms(

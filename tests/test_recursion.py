@@ -96,3 +96,50 @@ def test_recursive_verifier_rejects_bad_proof():
     recursive_verify(outer, vk, bad, gates)
     outer_asm = outer.into_assembly()
     assert not check_if_satisfied(outer_asm)
+
+
+import os
+import pytest
+
+
+@pytest.mark.skipif(
+    not os.environ.get("BOOJUM_TPU_SLOW_TESTS"),
+    reason="full 130-column recursive prove takes many minutes on 1 CPU; "
+    "set BOOJUM_TPU_SLOW_TESTS=1 to run",
+)
+def test_recursive_proof_proves_and_verifies():
+    """The counterpart of the reference's recursive bench
+    (sha256_bench_recursive_poseidon2.sh / recursive_verifier.rs:2213
+    proving config): the 130-column recursive-verifier circuit itself goes
+    through setup -> prove -> verify, so a proof-of-a-proof exists."""
+    import time
+
+    from boojum_tpu.cs.gates import PublicInputGate
+
+    vk, proof, gates = _prove_inner()
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    pi_vars, _cap = recursive_verify(outer, vk, proof, gates)
+    # surface the inner public inputs as the outer circuit's own
+    for v in pi_vars:
+        PublicInputGate.place(outer, v)
+    outer_asm = outer.into_assembly()
+    outer_cfg = ProofConfig(
+        # the degree-aware selector tree keeps the degree-7 flattened
+        # Poseidon2 gate at depth 1, so LDE 8 suffices
+        fri_lde_factor=8,
+        merkle_tree_cap_size=8,
+        num_queries=4,
+        pow_bits=0,
+        fri_final_degree=16,
+    )
+    t0 = time.time()
+    outer_setup = generate_setup(outer_asm, outer_cfg)
+    outer_proof = prove(outer_asm, outer_setup, outer_cfg)
+    wall = time.time() - t0
+    assert verify(outer_setup.vk, outer_proof, outer_asm.gates), (
+        "recursive proof must verify"
+    )
+    print(f"recursive prove wall: {wall:.1f}s, trace {outer_asm.trace_len}")
+    # the outer proof's public inputs surface the inner ones
+    surfaced = [pi[2] for pi in outer_asm.public_inputs[: len(pi_vars)]]
+    assert surfaced == list(proof.public_inputs)
