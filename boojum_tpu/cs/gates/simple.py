@@ -562,6 +562,59 @@ class ZeroCheckWitnessGate(Gate):
         return cls._inst
 
 
+class LookupMarkerGate(Gate):
+    """Formal marker for general-purpose-columns lookups (reference
+    LookupFormalGate, lookup_marker.rs:39): rows holding this gate carry
+    lookup tuples in the general copy columns, the table id in the row's
+    first gate-constant column, and the gate's SELECTOR gates the lookup
+    argument's A relations. No quotient terms of its own.
+
+    principal_width is configured at registration time from the lookup
+    parameters (width columns per tuple, table id as constant)."""
+
+    name = "lookup_marker"
+    num_constants = 1  # the table id
+    num_terms = 0
+    max_degree = 0
+    is_lookup_marker = True
+
+    def __init__(self, width: int):
+        self.principal_width = width
+
+    def evaluate(self, ops, row, dst):
+        return  # marker: the lookup argument supplies the relations
+
+    def padding_instance(self, cs, constants=()):
+        """Fill a vacant instance with the table's row 0 (and bump its
+        multiplicity so the log-derivative sum stays balanced)."""
+        tid = int(constants[0])
+        table = cs.get_table(tid)
+        row0 = [int(v) for v in table.content[0]] + [0] * (
+            self.principal_width - table.width
+        )
+        pads = []
+        for v in row0:
+            p = cs.alloc_variable_without_value()
+            cs.resolver.set_value(p, v)
+            pads.append(p)
+        if cs.config.evaluate_witness:
+            key = (tid, 0)
+            cs.lookup_multiplicities[key] = (
+                cs.lookup_multiplicities.get(key, 0) + 1
+            )
+        return pads
+
+    _by_width: dict = {}
+
+    @classmethod
+    def instance(cls, width: int = 0):
+        g = cls._by_width.get(width)
+        if g is None:
+            g = cls(width)
+            cls._by_width[width] = g
+        return g
+
+
 class SimpleNonlinearityGate(Gate):
     """y = x^7 + c (reference simple_non_linearity_with_constant.rs)."""
 

@@ -220,7 +220,20 @@ class ConstraintSystem:
         keys = list(keys)
         while len(keys) < params.width:
             keys.append(self.zero_var())
-        self.lookup_rows.append((table_id, keys))
+        if not params.use_specialized_columns:
+            # general-purpose mode (reference
+            # enforce_lookup_over_general_purpose_columns,
+            # lookup_placement.rs:21): the tuple occupies general copy
+            # columns on a lookup-marker row whose constant is the table id
+            from ..gates.simple import LookupMarkerGate
+
+            self.place_gate(
+                LookupMarkerGate.instance(params.width),
+                keys,
+                (table_id,),
+            )
+        else:
+            self.lookup_rows.append((table_id, keys))
         if self.config.evaluate_witness:
 
             def bump(vals, table=table, table_id=table_id):
@@ -382,9 +395,12 @@ class ConstraintSystem:
         lookups_on = bool(self.lookup_rows) or (
             self.lookup_params.is_enabled and bool(self.lookup_tables)
         )
-        if lookups_on:
+        if lookups_on and self.lookup_params.use_specialized_columns:
             lookup_placement, table_id_col = self._place_lookups(n)
         else:
+            # general-purpose mode: tuples live in the general copy columns
+            # on lookup-marker rows; no specialized columns, no dedicated
+            # table-id column (the id is the marker row's gate constant)
             lookup_placement = np.zeros((0, n), dtype=np.int64)
             table_id_col = None
         # AFTER padding/lookup placement (both may register resolutions):
@@ -483,8 +499,39 @@ class CSAssembly:
         return self.geometry.num_witness_columns
 
     @property
+    def lookup_mode(self) -> str:
+        """'none' | 'specialized' | 'general' (reference LookupParameters
+        placement families, cs/mod.rs:227)."""
+        lp = self.lookup_params
+        if lp is None or not lp.is_enabled or not self.lookup_tables:
+            return "none"
+        if lp.use_specialized_columns:
+            return "specialized"
+        # general mode with zero placed lookups has no marker gate and
+        # therefore no lookup argument at all
+        return "general" if self.lookup_marker_gid() is not None else "none"
+
+    @property
     def lookups_enabled(self):
-        return self.num_lookup_cols > 0
+        return self.lookup_mode != "none"
+
+    @property
+    def num_lookup_subargs(self) -> int:
+        """Log-derivative sub-arguments: configured repetitions in
+        specialized mode; general columns // width in general mode
+        (reference SizeCalculator::num_sublookup_arguments)."""
+        mode = self.lookup_mode
+        if mode == "specialized":
+            return self.lookup_params.num_repetitions
+        if mode == "general":
+            return self.num_copy_cols // self.lookup_params.width
+        return 0
+
+    def lookup_marker_gid(self):
+        for i, g in enumerate(self.gates):
+            if getattr(g, "is_lookup_marker", False):
+                return i
+        return None
 
     def witness_vec(self) -> np.ndarray:
         """Flat resolver value arena for every allocated place (reference
@@ -518,7 +565,41 @@ class CSAssembly:
         wit_cols = scatter(self.wit_placement)
         lookup_cols = scatter(self.lookup_placement)
         multiplicities = None
-        if self.lookups_enabled:
+        if self.lookup_mode == "general":
+            multiplicities = np.zeros(self.trace_len, dtype=np.uint64)
+            lp = self.lookup_params
+            w = lp.width
+            mk_gid = self.lookup_marker_gid()
+            marker = self.gates[mk_gid]
+            reps = marker.num_repetitions(self.geometry)
+            rows = np.nonzero(self.row_gate == mk_gid)[0]
+            tids = np.array(
+                [int(self.gate_constants[int(r)][0]) for r in rows],
+                dtype=np.uint64,
+            )
+            # stack every marker slot's tuple: (1 + reps*w, num_rows)
+            stacked = np.vstack(
+                [tids[None, :]]
+                + [copy_cols[s * w : (s + 1) * w, rows] for s in range(reps)]
+            )
+            uniq, ucounts = np.unique(stacked, axis=1, return_counts=True)
+            for u in range(uniq.shape[1]):
+                tid = int(uniq[0, u])
+                assert tid != 0, (
+                    "marker row with table id 0 while recounting "
+                    "multiplicities from an external witness"
+                )
+                table = self.lookup_tables[tid - 1]
+                col = uniq[1:, u]
+                for s in range(reps):
+                    tup = tuple(
+                        int(col[s * w + j]) for j in range(table.width)
+                    )
+                    ridx = table.row_index(tup)
+                    multiplicities[self.table_offsets[tid] + ridx] += int(
+                        ucounts[u]
+                    )
+        elif self.lookups_enabled:
             multiplicities = np.zeros(self.trace_len, dtype=np.uint64)
             lp = self.lookup_params
             R, w = lp.num_repetitions, lp.width

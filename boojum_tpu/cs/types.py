@@ -52,11 +52,13 @@ class CSGeometry:
 class LookupParameters:
     """Lookup configuration (reference `LookupParameters`, src/cs/mod.rs:227).
 
-    Only the specialized-columns log-derivative mode is implemented for now
-    (the mode the SHA-256 benchmark uses); width = number of key-value columns
-    per sub-argument (excluding the table-id column), num_repetitions = number
-    of parallel sub-arguments, share_table_id = table id column folded into
-    the key columns.
+    width = number of key-value columns per sub-argument (excluding the
+    table-id column); num_repetitions = number of parallel sub-arguments
+    (specialized mode); share_table_id = table id carried as a per-row
+    constant; use_specialized_columns selects between dedicated lookup
+    columns (reference lookup_placement.rs:112) and the general-purpose
+    -columns mode where tuples live on selector-gated marker rows
+    (lookup_placement.rs:21).
     """
 
     width: int = 0
@@ -66,6 +68,10 @@ class LookupParameters:
 
     @property
     def is_enabled(self) -> bool:
+        if not self.use_specialized_columns:
+            # general-purpose mode: sub-arguments tile the general columns,
+            # so only the tuple width configures it
+            return self.width > 0
         return self.num_repetitions > 0
 
     @property

@@ -123,6 +123,10 @@ def recursive_verify(cs, vk, proof, gates):
     W = vk.num_wit_cols
     lp = vk.lookup_params
     lookups = lp is not None and lp.is_enabled
+    assert not (lookups and not lp.use_specialized_columns), (
+        "the in-circuit verifier supports specialized-columns lookups only "
+        "(general-purpose-columns recursion is a round-3 item)"
+    )
     M = 1 if lookups else 0
     R = lp.num_repetitions if lookups else 0
     wdt = lp.width if lookups else 0

@@ -258,8 +258,13 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     Ct = Cg + LC
     W = assembly.wit_placement.shape[0]
     lookups = assembly.lookups_enabled
+    lk_mode = assembly.lookup_mode
+    R_args = assembly.num_lookup_subargs
     M = 1 if lookups else 0
-    K = geometry.num_constant_columns + (1 if lookups else 0)
+    # the dedicated table-id constant column exists only in specialized mode
+    K = geometry.num_constant_columns + (
+        1 if lk_mode == "specialized" else 0
+    )
     lp = assembly.lookup_params
     TW = (lp.width + 1) if lookups else 0  # table setup columns
 
@@ -307,13 +312,38 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     )
     stage2_list = [z[0], z[1]] + [c for p in partials for c in (p[0], p[1])]
     num_partials = len(partials)
-    if lookups:
+    if lk_mode == "specialized":
         table_cols_dev = jnp.asarray(setup.constant_cols[-1])  # table-id col
         a_polys, b_poly = compute_lookup_polys(
             copy_vals[Cg:], table_cols_dev,
             jnp.asarray(assembly.stacked_table_columns(lp.width)),
             jnp.asarray(assembly.multiplicities),
-            lookup_beta, lookup_gamma, lp.num_repetitions, lp.width,
+            lookup_beta, lookup_gamma, R_args, lp.width,
+        )
+        for a in a_polys:
+            stage2_list += [a[0], a[1]]
+        stage2_list += [b_poly[0], b_poly[1]]
+    elif lk_mode == "general":
+        from .stages import compute_lookup_polys_general
+
+        mk_gid = assembly.lookup_marker_gid()
+        mk_path = setup.selector_paths[mk_gid]
+        tid_idx = len(mk_path)
+        # marker selector over H from the base constant columns
+        sel_h = None
+        one = jnp.uint64(1)
+        consts_dev = jnp.asarray(setup.constant_cols)
+        for bdx, bit in enumerate(mk_path):
+            col = consts_dev[bdx]
+            f = col if bit else gf.sub(jnp.broadcast_to(one, col.shape), col)
+            sel_h = f if sel_h is None else gf.mul(sel_h, f)
+        if sel_h is None:
+            sel_h = jnp.ones((n,), jnp.uint64)
+        a_polys, b_poly = compute_lookup_polys_general(
+            copy_vals[:Cg], consts_dev[tid_idx],
+            jnp.asarray(assembly.stacked_table_columns(lp.width)),
+            jnp.asarray(assembly.multiplicities), sel_h,
+            lookup_beta, lookup_gamma, R_args, lp.width,
         )
         for a in a_polys:
             stage2_list += [a[0], a[1]]
@@ -354,7 +384,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     total_alpha_terms = (
         num_gate_sweep_terms(assembly)
         + 1 + len(chunks)
-        + ((lp.num_repetitions + 1) if lookups else 0)
+        + ((R_args + 1) if lookups else 0)
     )
     alpha_pows = AlphaPows(alpha, total_alpha_terms)
     acc = gate_terms_contribution(
@@ -371,17 +401,34 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         ab_off = 2 + 2 * num_partials
         a_ldes = [
             (s2_lde_flat[ab_off + 2 * i], s2_lde_flat[ab_off + 2 * i + 1])
-            for i in range(lp.num_repetitions)
+            for i in range(R_args)
         ]
         b_lde = (
-            s2_lde_flat[ab_off + 2 * lp.num_repetitions],
-            s2_lde_flat[ab_off + 2 * lp.num_repetitions + 1],
+            s2_lde_flat[ab_off + 2 * R_args],
+            s2_lde_flat[ab_off + 2 * R_args + 1],
         )
-        lk_acc = lookup_quotient_terms(
-            a_ldes, b_lde, copy_lde_flat[Cg:], const_lde_flat[K - 1],
-            table_lde_flat, wit_lde_all[Ct + W], lookup_beta, lookup_gamma,
-            lp.num_repetitions, lp.width, alpha_pows,
-        )
+        if lk_mode == "specialized":
+            lk_acc = lookup_quotient_terms(
+                a_ldes, b_lde, copy_lde_flat[Cg:], const_lde_flat[K - 1],
+                table_lde_flat, wit_lde_all[Ct + W], lookup_beta,
+                lookup_gamma, R_args, lp.width, alpha_pows,
+            )
+        else:
+            from .stages import (
+                lookup_quotient_terms_general,
+                selector_poly_lde,
+            )
+
+            mk_path = setup.selector_paths[assembly.lookup_marker_gid()]
+            sel_lde = selector_poly_lde(const_lde_flat, mk_path)
+            if sel_lde is None:
+                sel_lde = jnp.ones((N,), jnp.uint64)
+            lk_acc = lookup_quotient_terms_general(
+                a_ldes, b_lde, copy_lde_flat[:Cg],
+                const_lde_flat[len(mk_path)], table_lde_flat,
+                wit_lde_all[Ct + W], sel_lde, lookup_beta, lookup_gamma,
+                R_args, lp.width, alpha_pows,
+            )
         acc = ext_f.add(acc, lk_acc)
     zh_inv = _vanishing_inv_brev(log_n, L)
     T = (gf.mul(acc[0], zh_inv), gf.mul(acc[1], zh_inv))
@@ -423,7 +470,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     if lookups:
         s2_mono_host = np.asarray(s2_mono[:, 0])
         ab_off = 2 + 2 * num_partials
-        for i in range(lp.num_repetitions + 1):
+        for i in range(R_args + 1):
             values_at_0.append(
                 (int(s2_mono_host[ab_off + 2 * i]),
                  int(s2_mono_host[ab_off + 2 * i + 1]))
@@ -454,7 +501,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     num_deep_terms = (
         B + 2
-        + ((lp.num_repetitions + 1) if lookups else 0)
+        + ((R_args + 1) if lookups else 0)
         + len(assembly.public_inputs)
     )
     deep_pows = AlphaPows(deep_ch, num_deep_terms)
@@ -481,7 +528,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     if lookups:
         inv_x = _inv_xs_brev(log_n, L)
         ab_off = 2 + 2 * num_partials
-        for i in range(lp.num_repetitions + 1):
+        for i in range(R_args + 1):
             c0, c1 = deep_pows.take(1)
             ch = (c0[0], c1[0])
             v0, v1 = values_at_0[i]

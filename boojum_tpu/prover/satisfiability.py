@@ -48,12 +48,85 @@ def check_if_satisfied(assembly, verbose: bool = False) -> bool:
     return True
 
 
+def _check_lookups_general(assembly, verbose: bool) -> bool:
+    """General-purpose-columns mode: tuples live on lookup-marker rows in
+    the general copy columns; the row's gate constant is the table id."""
+    lp = assembly.lookup_params
+    w = lp.width
+    mk_gid = assembly.lookup_marker_gid()
+    if mk_gid is None:
+        if verbose:
+            print("LOOKUP: general mode but no marker gate registered")
+        return False
+    marker = assembly.gates[mk_gid]
+    reps = marker.num_repetitions(assembly.geometry)
+    counts: dict = {}
+    rows = np.nonzero(assembly.row_gate == mk_gid)[0]
+    if rows.size == 0:
+        return True
+    tids = np.zeros(rows.size, dtype=np.uint64)
+    for k, row in enumerate(rows):
+        consts = assembly.gate_constants.get(int(row), ())
+        tids[k] = int(consts[0]) if consts else 0
+    # dedup whole marker rows (same trick as the specialized checker): one
+    # check per unique (tid, all-slot tuples) combination, not per row
+    stacked = np.vstack(
+        [tids[None, :]]
+        + [
+            assembly.copy_cols_values[s * w : (s + 1) * w, rows]
+            for s in range(reps)
+        ]
+    )
+    uniq, ucounts = np.unique(stacked, axis=1, return_counts=True)
+    for u in range(uniq.shape[1]):
+        tid = int(uniq[0, u])
+        times = int(ucounts[u])
+        if tid == 0:
+            if verbose:
+                print("LOOKUP: marker row(s) with no table id")
+            return False
+        table = assembly.lookup_tables[tid - 1]
+        col = uniq[1:, u]
+        for s in range(reps):
+            tup = tuple(int(col[s * w + j]) for j in range(table.width))
+            try:
+                ridx = table.row_index(tup)
+            except (KeyError, AssertionError):
+                if verbose:
+                    print(
+                        f"LOOKUP UNSATISFIED: slot {s} tuple {tup} "
+                        f"not in table {table.name}"
+                    )
+                return False
+            for j in range(table.width, w):
+                if int(col[s * w + j]) != 0:
+                    if verbose:
+                        print(f"LOOKUP: slot {s} pad not zero")
+                    return False
+            key = (tid, ridx)
+            counts[key] = counts.get(key, 0) + times
+    expected = np.zeros(assembly.trace_len, dtype=np.uint64)
+    for (tid, ridx), cnt in counts.items():
+        expected[assembly.table_offsets[tid] + ridx] = cnt
+    bad = np.nonzero(expected != np.asarray(assembly.multiplicities))[0]
+    if bad.size:
+        if verbose:
+            print(
+                f"LOOKUP: multiplicity mismatch at stacked rows "
+                f"{bad[:5].tolist()}"
+            )
+        return False
+    return True
+
+
 def _check_lookups(assembly, verbose: bool) -> bool:
     """Every placed lookup tuple is a table row and the multiplicity column
     counts exactly the placed tuples (reference satisfiability_test.rs lookup
     spot checks). Rows are deduplicated first (np.unique over stacked
     [table-id; lookup columns]) so the padding-dominated tail of large traces
     costs one check, not n."""
+    if assembly.lookup_mode == "general":
+        return _check_lookups_general(assembly, verbose)
     lp = assembly.lookup_params
     R, w = lp.num_repetitions, lp.width
     vals = assembly.lookup_cols_values

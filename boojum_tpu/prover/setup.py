@@ -38,7 +38,7 @@ def build_selector_tree(gates):
             num_constants=g.num_constants,
             degree=g.max_degree,
             needs_selector=True,
-            is_lookup=False,
+            is_lookup=getattr(g, "is_lookup_marker", False),
         )
         for i, g in enumerate(gates)
     ]
@@ -247,9 +247,11 @@ def generate_setup(assembly, config) -> SetupData:
     sigma = compute_sigma_values(full_placement, n)
     consts = build_constant_columns(assembly, selector_paths)
     if assembly.lookups_enabled:
-        consts = np.concatenate(
-            [consts, assembly.lookup_table_id_col[None, :]], axis=0
-        )
+        if assembly.lookup_table_id_col is not None:
+            # specialized mode: dedicated table-id constant column
+            consts = np.concatenate(
+                [consts, assembly.lookup_table_id_col[None, :]], axis=0
+            )
         table_cols = assembly.stacked_table_columns(assembly.lookup_params.width)
         setup_cols = np.concatenate([sigma, consts, table_cols], axis=0)
     else:

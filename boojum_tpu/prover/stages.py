@@ -383,6 +383,73 @@ def _lookup_denominators(
     )
 
 
+def compute_lookup_polys_general(
+    gen_cols, tid_col, table_cols, multiplicities, sel_h,
+    lookup_beta, lookup_gamma, num_subargs, width,
+):
+    """A_i and B polys over H for the GENERAL-PURPOSE-columns mode
+    (reference lookup_argument.rs / lookup_placement.rs:21): sub-arguments
+    tile the general copy columns, the table id is the marker row's gate
+    constant column, and A_i = selector(x)/agg_i(x) — zero off the marker
+    rows, where agg_i may be arbitrary (Fermat inversion maps 0 to 0)."""
+    b = ext_scalar(lookup_beta)
+    g = ext_scalar(lookup_gamma)
+    R = int(num_subargs)
+    dens = _lookup_denominators(
+        gen_cols, tid_col, table_cols, b, g, R, int(width),
+    )
+    inv = ext_f.batch_inverse(dens)
+    a_polys = [
+        (gf.mul(inv[0][i], sel_h), gf.mul(inv[1][i], sel_h))
+        for i in range(R)
+    ]
+    t_inv = (inv[0][R], inv[1][R])
+    b_poly = (
+        gf.mul(t_inv[0], multiplicities),
+        gf.mul(t_inv[1], multiplicities),
+    )
+    return a_polys, b_poly
+
+
+def lookup_quotient_terms_general(
+    a_ldes, b_lde, gen_lde_cols, tid_lde, table_ldes, mult_lde, sel_lde,
+    lookup_beta, lookup_gamma, num_subargs, width, alpha_pows: AlphaPows,
+):
+    """General-mode quotient contributions: per sub-arg
+    A_i(x)·agg_i(x) − selector(x); for B: B(x)·t_agg(x) − M(x)
+    (reference lookup_argument.rs quotient terms over general columns)."""
+    a0, a1 = alpha_pows.take(num_subargs + 1)
+    return _lookup_quotient_core_general(
+        a_ldes, b_lde, gen_lde_cols, tid_lde, table_ldes, mult_lde, sel_lde,
+        ext_scalar(lookup_beta), ext_scalar(lookup_gamma), a0, a1,
+        int(num_subargs), int(width),
+    )
+
+
+@partial(jax.jit, static_argnums=(11, 12))
+def _lookup_quotient_core_general(
+    a_ldes, b_lde, gen_lde_cols, tid_lde, table_ldes, mult_lde, sel_lde,
+    b, g, a0, a1, num_subargs, width,
+):
+    gpow = _ext_powers_traced(g, width + 1)
+    acc = None
+    for i in range(num_subargs):
+        cols = [gen_lde_cols[i * width + j] for j in range(width)]
+        den = aggregate_lookup_columns(cols, tid_lde, gpow, b)
+        term = ext_f.mul(a_ldes[i], den)
+        term = (gf.sub(term[0], sel_lde), term[1])
+        acc = accumulate_ext_ext(acc, term, (a0[i], a1[i]))
+    t_den = aggregate_lookup_columns(
+        [table_ldes[j] for j in range(width)], table_ldes[width], gpow, b
+    )
+    term = ext_f.mul(b_lde, t_den)
+    term = (gf.sub(term[0], mult_lde), term[1])
+    acc = accumulate_ext_ext(
+        acc, term, (a0[num_subargs], a1[num_subargs])
+    )
+    return acc
+
+
 def lookup_quotient_terms(
     a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
     lookup_beta, lookup_gamma, num_repetitions, width, alpha_pows: AlphaPows,

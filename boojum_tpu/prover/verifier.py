@@ -63,14 +63,20 @@ def verify(vk, proof, gates) -> bool:
     W = vk.num_wit_cols
     lp = vk.lookup_params
     lookups = lp is not None and lp.is_enabled
+    lk_specialized = lookups and lp.use_specialized_columns
     M = 1 if lookups else 0
-    R = lp.num_repetitions if lookups else 0
     wdt = lp.width if lookups else 0
-    K = geometry.num_constant_columns + (1 if lookups else 0)
+    if lk_specialized:
+        R = lp.num_repetitions
+    elif lookups:
+        R = Cg // wdt  # general mode: sub-arguments tile the general columns
+    else:
+        R = 0
+    K = geometry.num_constant_columns + (1 if lk_specialized else 0)
     TW = (wdt + 1) if lookups else 0
-    if not lookups and Ct != Cg:
+    if not lk_specialized and Ct != Cg:
         return False
-    if lookups and Ct != Cg + R * wdt:
+    if lk_specialized and Ct != Cg + R * wdt:
         return False
     if [g.name for g in gates] != list(vk.gate_names):
         return False
@@ -207,17 +213,43 @@ def verify(vk, proof, gates) -> bool:
     if lookups:
         ab_off = 2 * (1 + (num_chunks - 1))
         gpow = ext_f.powers_s(lookup_gamma, wdt + 1)
-        tid_at_z = const_vals[K - 1]
+        if lk_specialized:
+            tid_at_z = const_vals[K - 1]
+            a_numerator = ext_f.ONE_S
+            col_base = Cg
+        else:
+            # general mode: the table id is the marker row's constant and
+            # each A relation is gated by the marker's SELECTOR at z
+            mk_gid = next(
+                (
+                    i for i, g in enumerate(gates)
+                    if getattr(g, "is_lookup_marker", False)
+                ),
+                None,
+            )
+            if mk_gid is None:
+                return False  # general-mode VK but no marker gate supplied
+            mk_path = vk.selector_paths[mk_gid]
+            tid_at_z = const_vals[len(mk_path)]
+            sel_at_z = ext_f.ONE_S
+            for bdx, bit in enumerate(mk_path):
+                cb = const_vals[bdx]
+                sel_at_z = ext_f.mul_s(
+                    sel_at_z,
+                    cb if bit else ext_f.sub_s((1, 0), cb),
+                )
+            a_numerator = sel_at_z
+            col_base = 0
         for i in range(R):
             a_i = ext_from_pair(
                 s2_vals[ab_off + 2 * i], s2_vals[ab_off + 2 * i + 1]
             )
             den = lookup_beta
             for j in range(wdt):
-                wv = wit_vals[Cg + i * wdt + j]
+                wv = wit_vals[col_base + i * wdt + j]
                 den = ext_f.add_s(den, ext_f.mul_s(gpow[j], wv))
             den = ext_f.add_s(den, ext_f.mul_s(gpow[wdt], tid_at_z))
-            rel = ext_f.sub_s(ext_f.mul_s(a_i, den), ext_f.ONE_S)
+            rel = ext_f.sub_s(ext_f.mul_s(a_i, den), a_numerator)
             total = ext_f.add_s(total, ext_f.mul_s(rel, next(alpha_pows)))
         b_at_z = ext_from_pair(
             s2_vals[ab_off + 2 * R], s2_vals[ab_off + 2 * R + 1]
