@@ -7,6 +7,8 @@ from .simple import (
     PublicInputGate,
     ReductionGate,
     SelectionGate,
+    BoundedGateWrapper,
+    LookupMarkerGate,
     ZeroCheckGate,
     ZeroCheckWitnessGate,
     ParallelSelectionGate,

@@ -562,6 +562,51 @@ class ZeroCheckWitnessGate(Gate):
         return cls._inst
 
 
+class BoundedGateWrapper(Gate):
+    """Row-capping newtype around an inner gate (reference
+    BoundedGateWrapper, bounded_wrapper.rs:145, and the Bounded* allocator
+    variants): placement through the wrapper counts the rows the inner gate
+    occupies and refuses to exceed the cap — the circuit-builder contract
+    for budgeted regions. Constraint semantics are the inner gate's own.
+    """
+
+    def __init__(self, inner: Gate, max_rows: int):
+        self.inner = inner
+        self.max_rows = max_rows
+        # distinct gate identity: the wrapper gets its OWN rows/tooling and
+        # selector-tree slot, so unbounded placements of the same inner gate
+        # never share (or silently consume) budgeted rows
+        self.name = f"bounded_{inner.name}"
+        self.principal_width = inner.principal_width
+        self.witness_width = inner.witness_width
+        self.num_constants = inner.num_constants
+        self.num_terms = inner.num_terms
+        self.max_degree = inner.max_degree
+        self._rows_used: set = set()
+
+    def evaluate(self, ops, row, dst):
+        return self.inner.evaluate(ops, row, dst)
+
+    def padding_instance(self, cs, constants=()):
+        return self.inner.padding_instance(cs, constants)
+
+    def place(self, cs, var_places, constants=(), wit_places=()):
+        """Place one instance, enforcing the row budget BEFORE mutating
+        the constraint system."""
+        tool = cs._tooling.get((self.name, tuple(constants)))
+        opens_new_row = (
+            tool is None or tool[1] >= self.num_repetitions(cs.geometry)
+        )
+        if opens_new_row and len(self._rows_used) >= self.max_rows:
+            raise RuntimeError(
+                f"bounded gate {self.inner.name}: row budget "
+                f"{self.max_rows} exceeded"
+            )
+        off, row = cs.place_gate(self, var_places, constants, wit_places)
+        self._rows_used.add(row)
+        return off, row
+
+
 class LookupMarkerGate(Gate):
     """Formal marker for general-purpose-columns lookups (reference
     LookupFormalGate, lookup_marker.rs:39): rows holding this gate carry

@@ -261,3 +261,37 @@ def test_resolution_record_playback():
 
     with pytest.raises(RuntimeError, match="playback divergence"):
         cs3.resolver.wait_till_resolved()
+
+
+def test_bounded_gate_wrapper():
+    """Row-capped placement (reference BoundedGateWrapper / Bounded*
+    allocator variants): instances amortize into rows normally, and the
+    wrapper rejects placements beyond the row budget."""
+    import pytest
+
+    from boojum_tpu.cs.gates import BoundedGateWrapper, FmaGate
+
+    cs = fresh_cs(64)
+    bounded = BoundedGateWrapper(FmaGate.instance(), max_rows=2)
+    per_row = FmaGate.instance().num_repetitions(GEOM)
+    for _ in range(2 * per_row):  # exactly fills the budget
+        a = cs.alloc_variable_with_value(2)
+        b = cs.alloc_variable_with_value(3)
+        c = cs.alloc_variable_with_value(4)
+        d = cs.alloc_variable_without_value()
+        cs.set_values_with_dependencies(
+            [a, b, c], [d], lambda v: [(v[0] * v[1] + v[2]) % gl.P]
+        )
+        bounded.place(cs, [a, b, c, d], (1, 1))
+    # the budget is exactly full: the next placement would open a third
+    # row and must be refused BEFORE the CS is mutated
+    rows_before = cs.next_row
+    a = cs.alloc_variable_with_value(5)
+    d = cs.alloc_variable_without_value()
+    cs.set_values_with_dependencies(
+        [a], [d], lambda v: [(v[0] * v[0] + v[0]) % gl.P]
+    )
+    with pytest.raises(RuntimeError, match="row budget"):
+        bounded.place(cs, [a, a, a, d], (1, 1))
+    assert cs.next_row == rows_before  # nothing was placed
+    assert check_if_satisfied(cs.into_assembly(), verbose=True)
