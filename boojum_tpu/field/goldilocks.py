@@ -169,8 +169,29 @@ def prefix_product(a: jax.Array) -> jax.Array:
     return a
 
 
-@jax.jit
 def batch_inverse(a: jax.Array) -> jax.Array:
+    """Montgomery batch inversion along the last axis.
+
+    Dispatches to the fused two-pass Pallas block-scan kernel on TPU
+    (field/pallas_scan.py — bit-identical results); the log-doubling XLA
+    form below is the generic path. Opt-in (BOOJUM_TPU_PALLAS_SCAN=1): the
+    (64,128)-tile sequential grid measured ~10x slower than the XLA scans
+    on v5e (carry serialization defeats pipelining) — kept for the kernel
+    parity surface until the tile scheme is reworked."""
+    import os
+
+    from ..utils.pallas_util import pallas_enabled
+
+    if os.environ.get("BOOJUM_TPU_PALLAS_SCAN", "0") == "1" and pallas_enabled():
+        from . import pallas_scan
+
+        if pallas_scan.size_fits(a.shape[-1]):
+            return pallas_scan.batch_inverse(a)
+    return batch_inverse_xla(a)
+
+
+@jax.jit
+def batch_inverse_xla(a: jax.Array) -> jax.Array:
     """Montgomery batch inversion along the last axis.
 
     Two modular prefix-product passes plus ONE Fermat inversion (the

@@ -1,0 +1,261 @@
+"""Goldilocks field arithmetic on 2x uint32 limb pairs — the Pallas form.
+
+TPU vector units have no 64-bit integer datapath: Mosaic (the Pallas TPU
+compiler) rejects u64 values inside kernels, and XLA's u64 emulation cannot be
+fused across kernel boundaries. This module is the 32-bit-limb field
+representation the kernels compute in — the TPU counterpart of the reference's
+per-ISA `MixedGL` backends (`/root/reference/src/field/goldilocks/
+avx512_impl.rs`, `arm_asm_impl.rs`): where those pack 16 Goldilocks lanes into
+AVX-512/NEON registers, these ops treat a field element as a pair of same-shape
+uint32 arrays `(lo, hi)` and express add/sub/mul/reduce in pure `jnp` uint32
+ops, so the SAME code runs inside Pallas kernels (VPU lanes over VMEM tiles)
+and as plain XLA (CPU fallback / interpret-mode tests).
+
+All scalar-level algorithms match `field/goldilocks.py` exactly (EPSILON
+reduction, wrap/borrow fixups); values are kept canonical in [0, p). The
+32x32->64 product uses a 16-bit split (4 VPU multiplies) because the TPU's
+integer multiplier returns only the low 32 bits.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gl
+
+_u32 = jnp.uint32
+U16_MASK = np.uint32(0xFFFF)
+# p = 2^64 - 2^32 + 1 as limbs: lo = 1, hi = 0xFFFFFFFF
+P_LO = np.uint32(1)
+P_HI = np.uint32(0xFFFFFFFF)
+EPS = np.uint32(0xFFFFFFFF)  # 2^32 - 1 == 2^64 mod p (fits one limb)
+
+
+# ---------------------------------------------------------------------------
+# u64 <-> limb conversions (run OUTSIDE kernels, plain XLA)
+# ---------------------------------------------------------------------------
+
+
+def split(x: jax.Array):
+    """uint64 array -> (lo, hi) uint32 pair."""
+    return (
+        (x & jnp.uint64(0xFFFFFFFF)).astype(_u32),
+        (x >> jnp.uint64(32)).astype(_u32),
+    )
+
+
+def join(pair) -> jax.Array:
+    """(lo, hi) uint32 pair -> uint64 array."""
+    lo, hi = pair
+    return lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << jnp.uint64(32))
+
+
+def const_pair(value: int):
+    """A python-int field constant as numpy uint32 scalars (kernel-bakeable)."""
+    v = int(value) % gl.P
+    return np.uint32(v & 0xFFFFFFFF), np.uint32(v >> 32)
+
+
+def split_np(x: np.ndarray):
+    """Host-side split for precomputed tables."""
+    x = np.asarray(x, dtype=np.uint64)
+    return (
+        (x & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+        (x >> np.uint64(32)).astype(np.uint32),
+    )
+
+
+# ---------------------------------------------------------------------------
+# 32-bit building blocks
+# ---------------------------------------------------------------------------
+
+
+def _b2u(x) -> jax.Array:
+    return x.astype(_u32)
+
+
+def mul32_wide(a, b):
+    """Full 32x32 -> 64-bit product as (lo, hi) uint32 pair.
+
+    16-bit split: the exact high half fits uint32, so intermediate mod-2^32
+    wraps cancel (the final values are exact)."""
+    a0 = a & U16_MASK
+    a1 = a >> 16
+    b0 = b & U16_MASK
+    b1 = b >> 16
+    ll = a0 * b0
+    lh = a0 * b1
+    hl = a1 * b0
+    hh = a1 * b1
+    mid = lh + hl  # 33-bit true value; capture the wrap bit
+    mid_c = _b2u(mid < lh)
+    lo = ll + (mid << 16)
+    lo_c = _b2u(lo < ll)
+    hi = hh + (mid >> 16) + (mid_c << 16) + lo_c
+    return lo, hi
+
+
+def add64(a, b):
+    """(lo, hi, carry) of a 64-bit add over limb pairs."""
+    lo = a[0] + b[0]
+    c = _b2u(lo < a[0])
+    t = a[1] + b[1]
+    c1 = _b2u(t < a[1])
+    hi = t + c
+    c2 = _b2u(hi < t)
+    return lo, hi, c1 | c2
+
+
+def sub64(a, b):
+    """(lo, hi, borrow) of a 64-bit subtract over limb pairs."""
+    lo = a[0] - b[0]
+    br = _b2u(a[0] < b[0])
+    t = a[1] - b[1]
+    b1 = _b2u(a[1] < b[1])
+    hi = t - br
+    b2 = _b2u(t < br)
+    return lo, hi, b1 | b2
+
+
+def _plus_eps_where(lo, hi, cond):
+    """(lo,hi) + EPSILON where cond (cond in {0,1} uint32).
+
+    Adding 0xFFFFFFFF to lo = lo - 1 with carry-out iff lo != 0."""
+    new_lo = lo - cond
+    new_hi = hi + (cond & _b2u(lo != 0))
+    return new_lo, new_hi
+
+
+def _minus_eps_where(lo, hi, cond):
+    """(lo,hi) - EPSILON where cond: lo + 1 with borrow-out iff lo == max."""
+    new_lo = lo + cond
+    new_hi = hi - (cond & _b2u(lo != EPS))
+    return new_lo, new_hi
+
+
+def _canonicalize(lo, hi):
+    """Subtract p once where (lo,hi) >= p. Input < p + 2^32 (so one pass)."""
+    ge = _b2u(hi == P_HI) & _b2u(lo >= P_LO)
+    return lo - ge, jnp.where(ge, jnp.zeros_like(hi), hi)
+
+
+# ---------------------------------------------------------------------------
+# Field ops on limb pairs (canonical in, canonical out)
+# ---------------------------------------------------------------------------
+
+
+def add(a, b):
+    lo, hi, c = add64(a, b)
+    lo, hi = _plus_eps_where(lo, hi, c)
+    return _canonicalize(lo, hi)
+
+
+def sub(a, b):
+    lo, hi, br = sub64(a, b)
+    return _minus_eps_where(lo, hi, br)
+
+
+def neg(a):
+    z = jnp.zeros_like(a[0])
+    return sub((z, z), a)
+
+
+def double(a):
+    return add(a, a)
+
+
+def mul_wide(a, b):
+    """Full 64x64 -> 128-bit product as 4 uint32 limbs (p0 lowest)."""
+    ll_lo, ll_hi = mul32_wide(a[0], b[0])
+    lh_lo, lh_hi = mul32_wide(a[0], b[1])
+    hl_lo, hl_hi = mul32_wide(a[1], b[0])
+    hh_lo, hh_hi = mul32_wide(a[1], b[1])
+    s1 = ll_hi + lh_lo
+    c1 = _b2u(s1 < ll_hi)
+    p1 = s1 + hl_lo
+    c2 = _b2u(p1 < s1)
+    carry1 = c1 + c2  # 0..2
+    s2 = lh_hi + hl_hi
+    d1 = _b2u(s2 < lh_hi)
+    s3 = s2 + hh_lo
+    d2 = _b2u(s3 < s2)
+    p2 = s3 + carry1
+    d3 = _b2u(p2 < s3)
+    p3 = hh_hi + d1 + d2 + d3
+    return ll_lo, p1, p2, p3
+
+
+def reduce128(p0, p1, p2, p3):
+    """(p3·2^96 + p2·2^64 + p1·2^32 + p0) mod p, canonical.
+
+    Same identity as goldilocks.reduce128: x ≡ lo64 - hi_hi + hi_lo·ε with
+    hi_lo·ε = hi_lo·2^32 - hi_lo computed without a multiply."""
+    # t0 = lo64 - p3 (64-bit), borrow -> -= EPSILON
+    lo, hi, br = sub64((p0, p1), (p3, jnp.zeros_like(p3)))
+    lo, hi = _minus_eps_where(lo, hi, br)
+    # t1 = p2 * EPSILON = (p2 << 32) - p2
+    nz = _b2u(p2 != 0)
+    t1_lo = jnp.zeros_like(p2) - p2
+    t1_hi = p2 - nz
+    # t2 = t0 + t1, carry -> += EPSILON
+    lo2, hi2, c = add64((lo, hi), (t1_lo, t1_hi))
+    lo2, hi2 = _plus_eps_where(lo2, hi2, c)
+    return _canonicalize(lo2, hi2)
+
+
+def mul(a, b):
+    return reduce128(*mul_wide(a, b))
+
+
+def sqr(a):
+    """a*a, sharing the cross product (12 VPU multiplies instead of 16)."""
+    ll_lo, ll_hi = mul32_wide(a[0], a[0])
+    lh_lo, lh_hi = mul32_wide(a[0], a[1])
+    hh_lo, hh_hi = mul32_wide(a[1], a[1])
+    # cross term appears twice: (lh << 32) * 2
+    x_lo = lh_lo << 1
+    xc0 = lh_lo >> 31
+    x_hi = (lh_hi << 1) | xc0
+    xc1 = lh_hi >> 31  # carry into p3
+    s1 = ll_hi + x_lo
+    c1 = _b2u(s1 < ll_hi)
+    s2 = hh_lo + x_hi
+    d1 = _b2u(s2 < hh_lo)
+    p2 = s2 + c1
+    d2 = _b2u(p2 < s2)
+    p3 = hh_hi + xc1 + d1 + d2
+    return reduce128(ll_lo, s1, p2, p3)
+
+
+def mul_const(a, c_pair):
+    """Multiply by a baked (np.uint32, np.uint32) constant pair."""
+    clo, chi = c_pair
+    b = (jnp.full_like(a[0], clo), jnp.full_like(a[1], chi))
+    return mul(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Quadratic extension GF(p^2) = GF(p)[w]/(w^2 - 7) on limb pairs
+# ---------------------------------------------------------------------------
+
+_SEVEN = (np.uint32(7), np.uint32(0))
+
+
+def ext_add(a, b):
+    return add(a[0], b[0]), add(a[1], b[1])
+
+
+def ext_sub(a, b):
+    return sub(a[0], b[0]), sub(a[1], b[1])
+
+
+def ext_mul(a, b):
+    """(a0 + a1 w)(b0 + b1 w) = a0b0 + 7 a1b1 + (a0b1 + a1b0) w."""
+    v0 = mul(a[0], b[0])
+    v1 = mul(a[1], b[1])
+    t = mul(add(a[0], a[1]), add(b[0], b[1]))
+    c1 = sub(t, add(v0, v1))
+    c0 = add(v0, mul_const(v1, _SEVEN))
+    return c0, c1

@@ -1,0 +1,447 @@
+"""Fused prefix-product scans and Montgomery batch inversion as Pallas TPU
+kernels over u32 limb planes.
+
+The XLA path (`goldilocks.prefix_product` / `batch_inverse`) is a
+Hillis-Steele log-doubling scan: log2(n) full passes over HBM per scan, and a
+batch inversion is two scans plus combines (~70 array passes at n = 2^16).
+This module is the TPU-kernel counterpart of the reference's serial
+Montgomery trick (`/root/reference/src/cs/implementations/utils.rs:405`
+batch_inverse / its parallel chunked form): a classic block-scan —
+
+  pass A (left->right grid): per (64, 128) VMEM tile, an in-tile
+    Hillis-Steele (7 lane-roll steps + 6 sublane-roll steps on row totals),
+    then multiply by a running carry kept in VMEM scratch across grid steps
+    -> the inclusive prefix products P in 2 HBM passes, plus a per-tile
+    carry-out row (the prefix up to the tile's end, lane-replicated) used by
+    pass B for tile-boundary values.
+  middle: ONE Fermat inversion of the per-row totals (tiny, XLA).
+  pass B (right->left grid): inverses out[i] = P[i-1] * S_excl[i] * T^-1,
+    where the exclusive suffix products S come from an in-tile reverse scan
+    plus a right-to-left carry; P[i-1] at a tile's first element is the left
+    neighbor's carry-out row from pass A.
+
+Mosaic layout note: every cross-tile value is kept as a real (1, 128)
+lane-replicated row (scratch or pass-A output) — Mosaic cannot broadcast a
+(1, 1) scalar to both sublanes and lanes in one op, so scalars never appear;
+replication happens by lane-broadcasting an (R, 1) column (legal) and
+slicing one row.
+
+All products are exact mod-p field ops, so results are BIT-IDENTICAL to the
+XLA path regardless of association order. Scan element order is the flat
+row-major order of the (rows, 128) tile view — i.e. the array's natural last
+axis order, matching the XLA scans.
+
+An extension-field (GF(p^2)) inclusive scan kernel is included for the
+copy-permutation grand product z (prover/stages.py:_ext_prefix_prod), whose
+XLA form pays 3x the passes (each ext mul is 3 base muls).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import gl
+from . import limbs
+from ..utils.pallas_util import imap32
+
+_LANE = 128
+_ROWS = 64  # tile rows: 64x128 = 8192 elements per grid step
+_MIN_N = 1 << 13  # below this the XLA scans win (kernel launch overhead)
+
+
+def size_fits(n: int) -> bool:
+    return n >= _MIN_N and n % (_ROWS * _LANE) == 0
+
+
+# ---------------------------------------------------------------------------
+# In-tile scan helpers (operate on limb pairs of shape (R, 128))
+# ---------------------------------------------------------------------------
+
+
+def _iota(shape, axis):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, axis)
+
+
+def _roll(x, k, axis):
+    return (jnp.roll(x[0], k, axis=axis), jnp.roll(x[1], k, axis=axis))
+
+
+def _where(mask, a, b):
+    return (jnp.where(mask, a[0], b[0]), jnp.where(mask, a[1], b[1]))
+
+
+def _ones_like(x):
+    return (jnp.ones_like(x[0]), jnp.zeros_like(x[1]))
+
+
+def _rep_row(col_pair, idx: int, R: int):
+    """(R, 1) limb-pair column -> its row `idx` replicated as (1, 128).
+
+    Lane-broadcast of a column is legal in Mosaic; slicing then avoids the
+    unsupported (1,1)->both-axes broadcast."""
+    full = (
+        jnp.broadcast_to(col_pair[0], (R, _LANE)),
+        jnp.broadcast_to(col_pair[1], (R, _LANE)),
+    )
+    return (full[0][idx : idx + 1], full[1][idx : idx + 1])
+
+
+def _tile_incl_scan(x, mul):
+    """Inclusive product scan of an (R, 128) tile in flat row-major order.
+
+    Returns (scanned, row_totals_incl) where row_totals_incl is (R, 1)."""
+    R = x[0].shape[0]
+    lane = _iota(x[0].shape, 1)
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        x = _where(lane >= k, mul(x, _roll(x, k, 1)), x)
+    t = (x[0][:, _LANE - 1 :], x[1][:, _LANE - 1 :])
+    row = _iota(t[0].shape, 0)
+    k = 1
+    while k < R:
+        t = _where(row >= k, mul(t, _roll(t, k, 0)), t)
+        k *= 2
+    excl = _roll(t, 1, 0)
+    excl = _where(row == 0, _ones_like(excl), excl)
+    return mul(x, excl), t
+
+
+def _tile_rev_incl_scan(x, mul):
+    """Reverse (suffix) inclusive product scan of an (R, 128) tile.
+
+    Returns (scanned, row_suffix_totals_incl (R, 1))."""
+    R = x[0].shape[0]
+    lane = _iota(x[0].shape, 1)
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        x = _where(lane < _LANE - k, mul(x, _roll(x, -k, 1)), x)
+    t = (x[0][:, :1], x[1][:, :1])
+    row = _iota(t[0].shape, 0)
+    k = 1
+    while k < R:
+        t = _where(row < R - k, mul(t, _roll(t, -k, 0)), t)
+        k *= 2
+    excl = _roll(t, -1, 0)
+    excl = _where(row == R - 1, _ones_like(excl), excl)
+    return mul(x, excl), t
+
+
+# ---------------------------------------------------------------------------
+# Pass A: inclusive prefix products (+ per-tile carry-out rows)
+# ---------------------------------------------------------------------------
+
+
+def _prefix_kernel(xl, xh, ol, oh, col, coh, clo, chi):
+    nb = pl.program_id(1)
+
+    @pl.when(nb == 0)
+    def _():
+        clo[:] = jnp.ones((1, _LANE), jnp.uint32)
+        chi[:] = jnp.zeros((1, _LANE), jnp.uint32)
+
+    x = (xl[0, 0], xh[0, 0])
+    R = x[0].shape[0]
+    scanned, totals = _tile_incl_scan(x, limbs.mul)
+    carry = (clo[:], chi[:])
+    scanned = limbs.mul(scanned, carry)
+    tile_total = _rep_row(totals, R - 1, R)
+    new_carry = limbs.mul(carry, tile_total)
+    clo[:] = new_carry[0]
+    chi[:] = new_carry[1]
+    ol[0, 0] = scanned[0]
+    oh[0, 0] = scanned[1]
+    col[0, 0] = new_carry[0]
+    coh[0, 0] = new_carry[1]
+
+
+# ---------------------------------------------------------------------------
+# Pass B: inverses from prefixes + reverse scan
+# ---------------------------------------------------------------------------
+
+
+def _inv_kernel(NB: int, al, ah, pl_, ph_, bl, bh, tl, th,
+                ol, oh, clo, chi):
+    nb_rev = pl.program_id(1)  # 0 = rightmost tile
+
+    @pl.when(nb_rev == 0)
+    def _():
+        clo[:] = jnp.ones((1, _LANE), jnp.uint32)
+        chi[:] = jnp.zeros((1, _LANE), jnp.uint32)
+
+    a = (al[0, 0], ah[0, 0])
+    P = (pl_[0, 0], ph_[0, 0])
+    R = a[0].shape[0]
+    lane = _iota(a[0].shape, 1)
+    row = _iota(a[0].shape, 0)
+
+    # exclusive suffix products within the tile, then fold in the right carry
+    s_incl, s_tot = _tile_rev_incl_scan(a, limbs.mul)
+    nxt = _roll(s_incl, -1, 1)  # lane l <- lane l+1
+    col_next = _roll((s_incl[0][:, :1], s_incl[1][:, :1]), -1, 0)
+    nxt = _where(
+        lane == _LANE - 1,
+        (
+            jnp.broadcast_to(col_next[0], a[0].shape),
+            jnp.broadcast_to(col_next[1], a[1].shape),
+        ),
+        nxt,
+    )
+    s_excl = _where((row == R - 1) & (lane == _LANE - 1), _ones_like(nxt), nxt)
+    carry = (clo[:], chi[:])
+    s_excl = limbs.mul(s_excl, carry)
+
+    # shifted prefix P[i-1]: lane shift, row boundary, tile boundary
+    prv = _roll(P, 1, 1)
+    col_prev = _roll((P[0][:, -1:], P[1][:, -1:]), 1, 0)
+    prv = _where(
+        lane == 0,
+        (
+            jnp.broadcast_to(col_prev[0], a[0].shape),
+            jnp.broadcast_to(col_prev[1], a[1].shape),
+        ),
+        prv,
+    )
+    first = (row == 0) & (lane == 0)
+    is_first_tile = nb_rev == NB - 1
+    # left neighbor's pass-A carry-out row: the prefix up to this tile's
+    # start, lane-replicated real data (bl/bh read the nb-1 tile, clamped)
+    pp_row = (bl[0, 0], bh[0, 0])  # (1, 128)
+    boundary = _where(
+        is_first_tile,
+        _ones_like(prv),
+        (
+            jnp.broadcast_to(pp_row[0], a[0].shape),
+            jnp.broadcast_to(pp_row[1], a[1].shape),
+        ),
+    )
+    prv = _where(first, boundary, prv)
+
+    tinv = (tl[0], th[0])  # (1, 128) replicated total inverse
+    out = limbs.mul(limbs.mul(prv, s_excl), tinv)
+    ol[0, 0] = out[0]
+    oh[0, 0] = out[1]
+
+    new_carry = limbs.mul(carry, _rep_row(s_tot, 0, R))
+    clo[:] = new_carry[0]
+    chi[:] = new_carry[1]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+_CP = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _prefix_planes(planes, interpret: bool):
+    lo, hi = planes
+    B, NB, R, _ = lo.shape
+    spec = pl.BlockSpec(
+        (1, 1, R, _LANE),
+        imap32(lambda b, nb: (b, nb, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    cspec = pl.BlockSpec(
+        (1, 1, 1, _LANE),
+        imap32(lambda b, nb: (b, nb, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct(lo.shape, jnp.uint32)
+    carry_shape = jax.ShapeDtypeStruct((B, NB, 1, _LANE), jnp.uint32)
+    return pl.pallas_call(
+        _prefix_kernel,
+        grid=(B, NB),
+        out_shape=[out_shape, out_shape, carry_shape, carry_shape],
+        in_specs=[spec, spec],
+        out_specs=[spec, spec, cspec, cspec],
+        scratch_shapes=[
+            pltpu.VMEM((1, _LANE), jnp.uint32),
+            pltpu.VMEM((1, _LANE), jnp.uint32),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else _CP,
+    )(lo, hi)
+
+
+@partial(jax.jit, static_argnums=(3,))
+def _inverse_planes(a_planes, p4, tinv_planes, interpret: bool):
+    alo, ahi = a_planes
+    plo, phi, blo, bhi = p4
+    tlo, thi = tinv_planes
+    B, NB, R, _ = alo.shape
+
+    def rev(b, nb):
+        return (b, NB - 1 - nb, 0, 0)
+
+    def rev_prev(b, nb):
+        # left-neighbor tile of the one at rev(); clamps at 0 (masked in
+        # kernel via the first-tile predicate)
+        return (b, jnp.maximum(NB - 1 - nb - 1, 0), 0, 0)
+
+    spec = pl.BlockSpec(
+        (1, 1, R, _LANE), imap32(rev), memory_space=pltpu.VMEM
+    )
+    bspec = pl.BlockSpec(
+        (1, 1, 1, _LANE), imap32(rev_prev), memory_space=pltpu.VMEM
+    )
+    tspec = pl.BlockSpec(
+        (1, 1, _LANE),
+        imap32(lambda b, nb: (b, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct(alo.shape, jnp.uint32)
+    return pl.pallas_call(
+        partial(_inv_kernel, NB),
+        grid=(B, NB),
+        out_shape=[out_shape, out_shape],
+        in_specs=[spec, spec, spec, spec, bspec, bspec, tspec, tspec],
+        out_specs=[spec, spec],
+        scratch_shapes=[
+            pltpu.VMEM((1, _LANE), jnp.uint32),
+            pltpu.VMEM((1, _LANE), jnp.uint32),
+        ],
+        interpret=interpret,
+        compiler_params=None if interpret else _CP,
+    )(alo, ahi, plo, phi, blo, bhi, tlo, thi)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points
+# ---------------------------------------------------------------------------
+
+
+def _to_planes(a: jax.Array):
+    lead = a.shape[:-1]
+    n = a.shape[-1]
+    flat = a.reshape(-1, n // (_ROWS * _LANE), _ROWS, _LANE)
+    return limbs.split(flat), lead, n
+
+
+def prefix_product(a: jax.Array, interpret: bool = False) -> jax.Array:
+    """Inclusive modular prefix product along the last axis (u64 in/out)."""
+    planes, lead, n = _to_planes(a)
+    out = _prefix_planes(planes, interpret)
+    return limbs.join((out[0], out[1])).reshape(lead + (n,))
+
+
+def batch_inverse(a: jax.Array, interpret: bool = False) -> jax.Array:
+    """Montgomery batch inversion along the last axis (u64 in/out)."""
+    from . import goldilocks as gf
+
+    planes, lead, n = _to_planes(a)
+    plo, phi, blo, bhi = _prefix_planes(planes, interpret)
+    totals = limbs.join((blo[:, -1, 0, 0], bhi[:, -1, 0, 0]))  # (B,)
+    tinv = gf.inv(totals)
+    tinv_rep = jnp.broadcast_to(
+        tinv[:, None, None], totals.shape + (1, _LANE)
+    )
+    tinv_planes = limbs.split(tinv_rep)
+    out = _inverse_planes(planes, (plo, phi, blo, bhi), tinv_planes, interpret)
+    return limbs.join(out).reshape(lead + (n,))
+
+
+# ---------------------------------------------------------------------------
+# Extension-field inclusive scan (for the grand-product z)
+# ---------------------------------------------------------------------------
+
+
+def _ext_prefix_kernel(x0l, x0h, x1l, x1h, o0l, o0h, o1l, o1h,
+                       c0l, c0h, c1l, c1h):
+    nb = pl.program_id(1)
+
+    @pl.when(nb == 0)
+    def _():
+        c0l[:] = jnp.ones((1, _LANE), jnp.uint32)
+        c0h[:] = jnp.zeros((1, _LANE), jnp.uint32)
+        c1l[:] = jnp.zeros((1, _LANE), jnp.uint32)
+        c1h[:] = jnp.zeros((1, _LANE), jnp.uint32)
+
+    def emul(a, b):
+        return limbs.ext_mul(a, b)
+
+    def eroll(x, k, axis):
+        return (_roll(x[0], k, axis), _roll(x[1], k, axis))
+
+    def ewhere(m, a, b):
+        return (_where(m, a[0], b[0]), _where(m, a[1], b[1]))
+
+    x = ((x0l[0, 0], x0h[0, 0]), (x1l[0, 0], x1h[0, 0]))
+    R = x[0][0].shape[0]
+    lane = _iota(x[0][0].shape, 1)
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        x = ewhere(lane >= k, emul(x, eroll(x, k, 1)), x)
+    t = (
+        (x[0][0][:, -1:], x[0][1][:, -1:]),
+        (x[1][0][:, -1:], x[1][1][:, -1:]),
+    )
+    row = _iota(t[0][0].shape, 0)
+    k = 1
+    while k < R:
+        t = ewhere(row >= k, emul(t, eroll(t, k, 0)), t)
+        k *= 2
+    excl = eroll(t, 1, 0)
+    eone = (
+        _ones_like(excl[0]),
+        (jnp.zeros_like(excl[1][0]), jnp.zeros_like(excl[1][1])),
+    )
+    excl = ewhere(row == 0, eone, excl)
+    x = emul(x, excl)
+    carry = ((c0l[:], c0h[:]), (c1l[:], c1h[:]))
+    x = emul(x, carry)
+
+    tile_total = (_rep_row(t[0], R - 1, R), _rep_row(t[1], R - 1, R))
+    new_carry = emul(carry, tile_total)
+    c0l[:] = new_carry[0][0]
+    c0h[:] = new_carry[0][1]
+    c1l[:] = new_carry[1][0]
+    c1h[:] = new_carry[1][1]
+    o0l[0, 0] = x[0][0]
+    o0h[0, 0] = x[0][1]
+    o1l[0, 0] = x[1][0]
+    o1h[0, 0] = x[1][1]
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _ext_prefix_planes(planes, interpret: bool):
+    p0l, p0h, p1l, p1h = planes
+    B, NB, R, _ = p0l.shape
+    spec = pl.BlockSpec(
+        (1, 1, R, _LANE),
+        imap32(lambda b, nb: (b, nb, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct(p0l.shape, jnp.uint32)
+    scr = [pltpu.VMEM((1, _LANE), jnp.uint32)] * 4
+    return pl.pallas_call(
+        _ext_prefix_kernel,
+        grid=(B, NB),
+        out_shape=[out_shape] * 4,
+        in_specs=[spec] * 4,
+        out_specs=[spec] * 4,
+        scratch_shapes=scr,
+        interpret=interpret,
+        compiler_params=None if interpret else _CP,
+    )(p0l, p0h, p1l, p1h)
+
+
+def ext_prefix_product(a, interpret: bool = False):
+    """Inclusive ext prefix product along the last axis; a = (c0, c1) u64."""
+    c0, c1 = a
+    lead = c0.shape[:-1]
+    n = c0.shape[-1]
+    shape = (-1, n // (_ROWS * _LANE), _ROWS, _LANE)
+    p0 = limbs.split(c0.reshape(shape))
+    p1 = limbs.split(c1.reshape(shape))
+    o0l, o0h, o1l, o1h = _ext_prefix_planes(
+        (p0[0], p0[1], p1[0], p1[1]), interpret
+    )
+    return (
+        limbs.join((o0l, o0h)).reshape(lead + (n,)),
+        limbs.join((o1l, o1h)).reshape(lead + (n,)),
+    )

@@ -1,0 +1,256 @@
+"""Poseidon2 permutation as a fused Pallas TPU kernel over u32 limb planes.
+
+The TPU counterpart of the reference's AVX-512 Poseidon2 state
+(`/root/reference/src/implementations/poseidon2/state_avx512.rs`): where that
+packs the width-12 state into 512-bit registers and keeps a whole permutation
+in-register, this kernel keeps a (12, TILE, 128) tile of states resident in
+VMEM for all 30 rounds — one HBM read and one write per permutation batch,
+instead of one round-trip per round (what the staged XLA version pays when the
+fused graph exceeds the fusion horizon).
+
+Layout: the batch axis is tiled (rows x 128 lanes); the state axis (12) and
+the limb axis (2) are leading dims, so every field op is an elementwise VPU op
+over (TILE, 128) tiles. Round constants live in SMEM as u32 limb pairs and are
+broadcast per round inside `fori_loop`s (4 full / 22 partial / 4 full — the
+same phase structure as `poseidon2.py`).
+
+Used by `poseidon2.py:poseidon2_permutation` when running on TPU (env
+BOOJUM_TPU_PALLAS=0 disables); bit-parity with the XLA path is asserted in
+tests/test_pallas_kernels.py (interpret mode on CPU + real kernels on TPU).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import limbs
+from . import poseidon2_params as params
+
+_RC = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
+_DIAG = np.array(params.M_I_DIAGONAL, dtype=np.uint64)
+
+# (30, 12) limb pairs -> (30, 24) u32: [lo(12) | hi(12)] per round
+_RC_U32 = np.concatenate(limbs.split_np(_RC), axis=1)
+_DIAG_PAIRS = [limbs.const_pair(int(d)) for d in _DIAG]
+
+
+def _sbox7(x):
+    x2 = limbs.sqr(x)
+    x3 = limbs.mul(x2, x)
+    x4 = limbs.sqr(x2)
+    return limbs.mul(x4, x3)
+
+
+def _block_m4(x0, x1, x2, x3):
+    add, dbl = limbs.add, limbs.double
+    t0 = add(x0, x1)
+    t1 = add(x2, x3)
+    t2 = add(dbl(x1), t1)
+    t3 = add(dbl(x3), t0)
+    t4 = add(dbl(dbl(t1)), t3)
+    t5 = add(dbl(dbl(t0)), t2)
+    t6 = add(t3, t5)
+    t7 = add(t2, t4)
+    return t6, t5, t7, t4
+
+
+def _external_mds(cols):
+    add = limbs.add
+    blocks = [_block_m4(*cols[4 * b : 4 * b + 4]) for b in range(3)]
+    sums = [
+        add(add(blocks[0][i], blocks[1][i]), blocks[2][i]) for i in range(4)
+    ]
+    return [add(blocks[b][i], sums[i]) for b in range(3) for i in range(4)]
+
+
+def _internal_mds(cols):
+    total = cols[0]
+    for c in cols[1:]:
+        total = limbs.add(total, c)
+    return [
+        limbs.add(limbs.mul_const(cols[i], _DIAG_PAIRS[i]), total)
+        for i in range(12)
+    ]
+
+
+def _stack(cols):
+    """12 (lo, hi) pairs of (T, 128) -> (lo12, hi12) stacked (12, T, 128)."""
+    lo = jnp.stack([c[0] for c in cols])
+    hi = jnp.stack([c[1] for c in cols])
+    return lo, hi
+
+
+def _unstack(lo, hi):
+    return [(lo[i], hi[i]) for i in range(12)]
+
+
+def _rc_pair(rc_ref, r, i, like):
+    lo = jnp.full_like(like[0], rc_ref[r, i])
+    hi = jnp.full_like(like[1], rc_ref[r, 12 + i])
+    return lo, hi
+
+
+def _permutation_body(rc_ref, cols):
+    """All 30 rounds on a list of 12 limb-pair (T, 128) values."""
+    cols = _external_mds(cols)
+
+    def full_round(r, carry):
+        lo, hi = carry
+        cs = _unstack(lo, hi)
+        cs = [
+            _sbox7(limbs.add(c, _rc_pair(rc_ref, r, i, c)))
+            for i, c in enumerate(cs)
+        ]
+        return _stack(_external_mds(cs))
+
+    def partial_round(r, carry):
+        lo, hi = carry
+        cs = _unstack(lo, hi)
+        cs[0] = _sbox7(limbs.add(cs[0], _rc_pair(rc_ref, r, 0, cs[0])))
+        return _stack(_internal_mds(cs))
+
+    carry = _stack(cols)
+    carry = jax.lax.fori_loop(0, 4, full_round, carry)
+    carry = jax.lax.fori_loop(4, 26, partial_round, carry)
+    carry = jax.lax.fori_loop(26, 30, full_round, carry)
+    return _unstack(*carry)
+
+
+def _perm_kernel(rc_ref, lo_ref, hi_ref, out_lo_ref, out_hi_ref):
+    cols = [(lo_ref[i], hi_ref[i]) for i in range(12)]
+    cols = _permutation_body(rc_ref, cols)
+    lo, hi = _stack(cols)
+    out_lo_ref[:] = lo
+    out_hi_ref[:] = hi
+
+
+def _sponge_kernel(num_chunks: int, rc_ref, vlo_ref, vhi_ref, olo_ref, ohi_ref):
+    """Overwrite-mode sponge over (L, T, 128) leaf-value planes -> (4, T, 128).
+
+    L is padded to 8*num_chunks with zeros by the wrapper; each chunk
+    overwrites the rate portion (state[0:8]) then permutes."""
+    zero = jnp.zeros(vlo_ref.shape[1:], jnp.uint32)
+    state = [(zero, zero)] * 12
+    for c in range(num_chunks):
+        rate = [
+            (vlo_ref[8 * c + j], vhi_ref[8 * c + j]) for j in range(8)
+        ]
+        state = rate + state[8:]
+        state = _permutation_body(rc_ref, state)
+    lo, hi = _stack(state[:4])
+    olo_ref[:] = lo
+    ohi_ref[:] = hi
+
+
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+from ..utils.pallas_util import imap32  # noqa: E402
+
+
+def _smem_spec():
+    # explicit block + index map: the default index map traces i64 under the
+    # global x64 flag, which Mosaic cannot legalize
+    return pl.BlockSpec(
+        (30, 24), imap32(lambda *_: (0, 0)), memory_space=pltpu.SMEM
+    )
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _permute_planes(lo, hi, tile_rows: int, interpret: bool):
+    """(12, R, 128) u32 limb planes -> permuted, grid over R tiles."""
+    R = lo.shape[1]
+    grid = (R // tile_rows,)
+    spec = pl.BlockSpec(
+        (12, tile_rows, 128),
+        imap32(lambda r: (0, r, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((12, R, 128), jnp.uint32)
+    return pl.pallas_call(
+        _perm_kernel,
+        grid=grid,
+        out_shape=[out_shape, out_shape],
+        in_specs=[_smem_spec(), spec, spec],
+        out_specs=[spec, spec],
+        interpret=interpret,
+    )(jnp.asarray(_RC_U32), lo, hi)
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def _sponge_planes(vlo, vhi, num_chunks: int, tile_rows: int, interpret: bool):
+    """(8*chunks, R, 128) value planes -> (4, R, 128) digest planes."""
+    L, R, _ = vlo.shape
+    grid = (R // tile_rows,)
+    in_spec = pl.BlockSpec(
+        (L, tile_rows, 128),
+        imap32(lambda r: (0, r, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_spec = pl.BlockSpec(
+        (4, tile_rows, 128),
+        imap32(lambda r: (0, r, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((4, R, 128), jnp.uint32)
+    return pl.pallas_call(
+        partial(_sponge_kernel, num_chunks),
+        grid=grid,
+        out_shape=[out_shape, out_shape],
+        in_specs=[_smem_spec(), in_spec, in_spec],
+        out_specs=[out_spec, out_spec],
+        interpret=interpret,
+    )(jnp.asarray(_RC_U32), vlo, vhi)
+
+
+def _pick_tile(R: int, budget_rows: int) -> int:
+    """Largest power-of-two tile <= budget_rows dividing R (min 1)."""
+    t = 1
+    while t * 2 <= min(R, budget_rows):
+        t *= 2
+    return t
+
+
+_LANE = 128
+_MIN_BATCH = 1024  # below this the XLA path wins (kernel launch overhead)
+
+
+def batch_fits(n: int) -> bool:
+    return n >= _MIN_BATCH and n % _LANE == 0
+
+
+def permutation(state: jax.Array, interpret: bool = False) -> jax.Array:
+    """Batched Poseidon2 permutation on (N, 12) uint64, N = R*128."""
+    n = state.shape[0]
+    assert n % _LANE == 0
+    R = n // _LANE
+    # (N, 12) -> (12, R, 128) limb planes
+    planes = state.T.reshape(12, R, _LANE)
+    lo, hi = limbs.split(planes)
+    tile = _pick_tile(R, 16)
+    olo, ohi = _permute_planes(lo, hi, tile, interpret)
+    out = limbs.join((olo, ohi))
+    return out.reshape(12, n).T
+
+
+def sponge_hash(values: jax.Array, interpret: bool = False) -> jax.Array:
+    """(N, L) uint64 leaf values -> (N, 4) digests (overwrite-mode sponge)."""
+    n, L = values.shape
+    assert n % _LANE == 0
+    num_chunks = max(1, (L + 7) // 8)
+    R = n // _LANE
+    planes = values.T.reshape(L, R, _LANE)
+    if L < 8 * num_chunks:
+        pad = jnp.zeros((8 * num_chunks - L, R, _LANE), values.dtype)
+        planes = jnp.concatenate([planes, pad], axis=0)
+    vlo, vhi = limbs.split(planes)
+    # VMEM budget: (L + out + temps) * tile * 128 * 4B * 2 planes
+    budget = max(1, (2 << 20) // max(8 * num_chunks * _LANE * 8, 1))
+    tile = _pick_tile(R, budget)
+    olo, ohi = _sponge_planes(vlo, vhi, num_chunks, tile, interpret)
+    out = limbs.join((olo, ohi))
+    return out.reshape(4, n).T

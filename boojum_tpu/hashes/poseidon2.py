@@ -72,7 +72,7 @@ def _internal_mds(state):
 
 
 @jax.jit
-def poseidon2_permutation(state: jax.Array) -> jax.Array:
+def poseidon2_permutation_xla(state: jax.Array) -> jax.Array:
     """Batched Poseidon2 permutation on (..., 12) uint64 arrays.
 
     Rounds run under `lax.fori_loop` (compiler-friendly control flow): the
@@ -104,7 +104,7 @@ def poseidon2_permutation(state: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def leaf_hash(values: jax.Array) -> jax.Array:
+def leaf_hash_xla(values: jax.Array) -> jax.Array:
     """Hash (..., L) field values into (..., 4) leaf digests.
 
     Overwrite-mode sponge: each full 8-chunk overwrites the rate portion then
@@ -118,23 +118,66 @@ def leaf_hash(values: jax.Array) -> jax.Array:
     for c in range(full):
         chunk = values[..., 8 * c : 8 * c + 8]
         state = jnp.concatenate([chunk, state[..., 8:]], axis=-1)
-        state = poseidon2_permutation(state)
+        state = poseidon2_permutation_xla(state)
     rem = L - 8 * full
     if rem > 0:
         chunk = values[..., 8 * full :]
         pad = jnp.zeros(lead + (8 - rem,), jnp.uint64)
         state = jnp.concatenate([chunk, pad, state[..., 8:]], axis=-1)
-        state = poseidon2_permutation(state)
+        state = poseidon2_permutation_xla(state)
     return state[..., :4]
 
 
 @jax.jit
-def node_hash(left: jax.Array, right: jax.Array) -> jax.Array:
+def node_hash_xla(left: jax.Array, right: jax.Array) -> jax.Array:
     """Hash two (..., 4) digests into a (..., 4) parent digest."""
     state = jnp.concatenate(
         [left, right, jnp.zeros(left.shape[:-1] + (4,), jnp.uint64)], axis=-1
     )
-    return poseidon2_permutation(state)[..., :4]
+    return poseidon2_permutation_xla(state)[..., :4]
+
+
+# ---------------------------------------------------------------------------
+# Dispatchers: fused Pallas kernels on TPU, XLA everywhere else. Results are
+# bit-identical (tests/test_pallas_kernels.py asserts parity).
+# ---------------------------------------------------------------------------
+
+
+def _pallas_ready(n: int) -> bool:
+    from ..utils.pallas_util import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    from . import pallas_poseidon2 as pp2
+
+    return pp2.batch_fits(n)
+
+
+def poseidon2_permutation(state: jax.Array) -> jax.Array:
+    """Batched Poseidon2 permutation on (..., 12) uint64 arrays."""
+    if state.ndim == 2 and _pallas_ready(state.shape[0]):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.permutation(state)
+    return poseidon2_permutation_xla(state)
+
+
+def leaf_hash(values: jax.Array) -> jax.Array:
+    """Hash (..., L) field values into (..., 4) leaf digests."""
+    if values.ndim == 2 and _pallas_ready(values.shape[0]):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.sponge_hash(values)
+    return leaf_hash_xla(values)
+
+
+def node_hash(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Hash two (..., 4) digests into a (..., 4) parent digest."""
+    if left.ndim == 2 and _pallas_ready(left.shape[0]):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.sponge_hash(jnp.concatenate([left, right], axis=-1))
+    return node_hash_xla(left, right)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,425 @@
+"""Radix-2 NTT as fused Pallas TPU kernels over u32 limb planes.
+
+The TPU-kernel counterpart of the reference's vectorized NTTs
+(`/root/reference/src/fft/mod.rs:852,1088` — MixedGL butterflies with
+interleaved twiddles): one column (or LDE coset) stays resident in VMEM for
+ALL log2(n) butterfly stages, so the transform costs one HBM read and one
+write instead of a round-trip per stage (the XLA-staged form's floor once the
+per-stage fusions are materialized).
+
+Layout: a length-n column is viewed as (n/128, 128) — sublanes x lanes.
+- stages with butterfly distance d >= 128 pair whole sublane groups:
+  a 4D reshape (blocks, 2, d/128, 128) splits u/v with no data movement;
+- stages with d < 128 pair elements within a lane row: `jnp.roll` along the
+  lane axis fetches the partner, a lane-index mask selects the u/v role
+  (the standard rotate-and-select vector butterfly).
+
+Twiddle VALUES are sliced from the same cached power tables the XLA path uses
+(`ntt.NTTContext`), packed per stage into (rows, 128) planes — outputs are
+bit-identical to `fft_natural_to_bitreversed`/`ifft_bitreversed_to_natural`
+by construction (same butterfly formulas, same constants, exact integer ops).
+
+The forward kernel optionally fuses the coset-scale multiply (LDE: scale by
+shift^i before transforming), saving the (cols, lde, n) scaled intermediate
+the XLA path materializes.
+
+Dispatch: `ntt.py` routes here on TPU for 2^11 <= n <= 2^17 (one column +
+twiddles + temporaries fit VMEM); larger transforms use the two-level
+decomposition in `pallas_ntt4.py`; CPU and tiny sizes keep the XLA path.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..field import gl
+from ..field import limbs
+from ..utils.pallas_util import imap32
+
+_LANE = 128
+
+
+def _as_rows(x: np.ndarray) -> np.ndarray:
+    return x.reshape(-1, _LANE)
+
+
+class PallasNTTContext:
+    """Packed per-stage twiddle planes for one transform size."""
+
+    def __init__(self, log_n: int):
+        from .ntt import get_ntt_context
+
+        self.log_n = log_n
+        n = self.n = 1 << log_n
+        ctx = get_ntt_context(log_n)
+        with jax.ensure_compile_time_eval():
+            tw = np.asarray(ctx.tw)  # omega^j, j < n/2
+            itw = np.asarray(ctx.itw)
+        self.n_inv = limbs.const_pair(gl.inv(n))
+
+        # forward (DIF): stage s has half-distance d = n >> (s+1),
+        # twiddle[j] = omega^(j << s)
+        fwd_rows, self.fwd_row_offs = [], []
+        fwd_lanes = []
+        self.fwd_stages = []
+        off = 0
+        for s in range(log_n):
+            d = n >> (s + 1)
+            if d >= _LANE:
+                self.fwd_stages.append(("row", d, off))
+                self.fwd_row_offs.append(off)
+                fwd_rows.append(_as_rows(tw[:: 1 << s][:d]))
+                off += d // _LANE
+            else:
+                # lane vector: t[j] = tw[(j % d) << s] (valid for both halves)
+                j = np.arange(_LANE)
+                vec = tw[((j % d) << s) % (n // 2)] if d > 0 else None
+                self.fwd_stages.append(("lane", d, len(fwd_lanes)))
+                fwd_lanes.append(vec)
+
+        # inverse (DIT): stage s has half-distance d = 1 << s,
+        # twiddle[j] = omega_inv^(j << (log_n - s - 1))
+        inv_rows = []
+        inv_lanes = []
+        self.inv_stages = []
+        off = 0
+        for s in range(log_n):
+            d = 1 << s
+            shift = log_n - s - 1
+            if d >= _LANE:
+                self.inv_stages.append(("row", d, off))
+                inv_rows.append(_as_rows(itw[:: 1 << shift][:d]))
+                off += d // _LANE
+            else:
+                j = np.arange(_LANE)
+                vec = itw[((j % d) << shift) % (n // 2)]
+                self.inv_stages.append(("lane", d, len(inv_lanes)))
+                inv_lanes.append(vec)
+
+        def pack(rows, lanes):
+            rows_arr = (
+                np.concatenate(rows, axis=0)
+                if rows
+                else np.zeros((1, _LANE), np.uint64)
+            )
+            lanes_arr = (
+                np.stack(lanes)
+                if lanes
+                else np.zeros((1, _LANE), np.uint64)
+            )
+            # pad lane-stage count to a sublane multiple
+            pad = (-lanes_arr.shape[0]) % 8
+            if pad:
+                lanes_arr = np.concatenate(
+                    [lanes_arr, np.zeros((pad, _LANE), np.uint64)]
+                )
+            return (
+                tuple(map(jnp.asarray, limbs.split_np(rows_arr))),
+                tuple(map(jnp.asarray, limbs.split_np(lanes_arr))),
+            )
+
+        # contexts are lru-cached across traces: materialize the device
+        # arrays eagerly even when first touched inside a jit trace
+        with jax.ensure_compile_time_eval():
+            self.fwd_tw = pack(fwd_rows, fwd_lanes)
+            self.inv_tw = pack(inv_rows, inv_lanes)
+
+
+@lru_cache(maxsize=None)
+def get_pallas_ctx(log_n: int) -> PallasNTTContext:
+    return PallasNTTContext(log_n)
+
+
+# ---------------------------------------------------------------------------
+# Kernel bodies (operate on (R, 128) limb-pair values)
+# ---------------------------------------------------------------------------
+
+
+def _lane_iota(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, len(shape) - 1)
+
+
+def _where(mask, a, b):
+    return (
+        jnp.where(mask, a[0], b[0]),
+        jnp.where(mask, a[1], b[1]),
+    )
+
+
+def _reshape(x, shape):
+    return x[0].reshape(shape), x[1].reshape(shape)
+
+
+def _stack2(a, b, axis):
+    return (
+        jnp.stack([a[0], b[0]], axis=axis),
+        jnp.stack([a[1], b[1]], axis=axis),
+    )
+
+
+def _fwd_stages_body(ctx: PallasNTTContext, x, trow, tlane):
+    """All DIF stages on an (R, 128) limb pair; returns same shape."""
+    R = ctx.n // _LANE
+    for kind, d, off in ctx.fwd_stages:
+        if kind == "row":
+            rows_d = d // _LANE
+            blocks = R // (2 * rows_d)
+            x4 = _reshape(x, (blocks, 2, rows_d, _LANE))
+            u = (x4[0][:, 0], x4[1][:, 0])
+            v = (x4[0][:, 1], x4[1][:, 1])
+            tw = (
+                trow[0][off : off + rows_d],
+                trow[1][off : off + rows_d],
+            )
+            top = limbs.add(u, v)
+            bot = limbs.mul(limbs.sub(u, v), tw)
+            x = _reshape(_stack2(top, bot, 1), (R, _LANE))
+        else:
+            tw = (tlane[0][off : off + 1], tlane[1][off : off + 1])
+            r1 = (
+                jnp.roll(x[0], -d, axis=-1),
+                jnp.roll(x[1], -d, axis=-1),
+            )
+            r2 = (
+                jnp.roll(x[0], d, axis=-1),
+                jnp.roll(x[1], d, axis=-1),
+            )
+            mask = (_lane_iota(x[0].shape) & jnp.int32(2 * d - 1)) < jnp.int32(d)
+            top = limbs.add(x, r1)
+            bot = limbs.mul(limbs.sub(r2, x), tw)
+            x = _where(mask, top, bot)
+    return x
+
+
+def _inv_stages_body(ctx: PallasNTTContext, x, trow, tlane):
+    """All DIT stages + 1/n scale on an (R, 128) limb pair."""
+    R = ctx.n // _LANE
+    for kind, d, off in ctx.inv_stages:
+        if kind == "lane":
+            tw = (tlane[0][off : off + 1], tlane[1][off : off + 1])
+            r1 = (
+                jnp.roll(x[0], -d, axis=-1),
+                jnp.roll(x[1], -d, axis=-1),
+            )
+            r2 = (
+                jnp.roll(x[0], d, axis=-1),
+                jnp.roll(x[1], d, axis=-1),
+            )
+            mask = (_lane_iota(x[0].shape) & jnp.int32(2 * d - 1)) < jnp.int32(d)
+            wv_first = limbs.mul(r1, tw)
+            wv_self = limbs.mul(x, tw)
+            x = _where(
+                mask, limbs.add(x, wv_first), limbs.sub(r2, wv_self)
+            )
+        else:
+            rows_d = d // _LANE
+            blocks = R // (2 * rows_d)
+            x4 = _reshape(x, (blocks, 2, rows_d, _LANE))
+            u = (x4[0][:, 0], x4[1][:, 0])
+            v = (x4[0][:, 1], x4[1][:, 1])
+            tw = (
+                trow[0][off : off + rows_d],
+                trow[1][off : off + rows_d],
+            )
+            wv = limbs.mul(v, tw)
+            x = _reshape(
+                _stack2(limbs.add(u, wv), limbs.sub(u, wv), 1), (R, _LANE)
+            )
+    return limbs.mul_const(x, ctx.n_inv)
+
+
+def _fwd_kernel(ctx, trl, trh, tll, tlh, xl, xh, ol, oh):
+    x = _fwd_stages_body(ctx, (xl[0], xh[0]), (trl[:], trh[:]), (tll[:], tlh[:]))
+    ol[0] = x[0]
+    oh[0] = x[1]
+
+
+def _fwd_scaled_kernel(ctx, trl, trh, tll, tlh, sl, sh, xl, xh, ol, oh):
+    x = limbs.mul((xl[0], xh[0]), (sl[0], sh[0]))
+    x = _fwd_stages_body(ctx, x, (trl[:], trh[:]), (tll[:], tlh[:]))
+    ol[0] = x[0]
+    oh[0] = x[1]
+
+
+def _inv_kernel(ctx, trl, trh, tll, tlh, xl, xh, ol, oh):
+    x = _inv_stages_body(ctx, (xl[0], xh[0]), (trl[:], trh[:]), (tll[:], tlh[:]))
+    ol[0] = x[0]
+    oh[0] = x[1]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
+def _tw_specs(ctx):
+    rows = ctx.fwd_tw[0][0].shape[0]
+    lanes = ctx.fwd_tw[1][0].shape[0]
+    row_spec = pl.BlockSpec(
+        (rows, _LANE), imap32(lambda *_: (0, 0)), memory_space=pltpu.VMEM
+    )
+    lane_spec = pl.BlockSpec(
+        (lanes, _LANE), imap32(lambda *_: (0, 0)), memory_space=pltpu.VMEM
+    )
+    return [row_spec, row_spec, lane_spec, lane_spec]
+
+
+def _itw_specs(ctx):
+    rows = ctx.inv_tw[0][0].shape[0]
+    lanes = ctx.inv_tw[1][0].shape[0]
+    row_spec = pl.BlockSpec(
+        (rows, _LANE), imap32(lambda *_: (0, 0)), memory_space=pltpu.VMEM
+    )
+    lane_spec = pl.BlockSpec(
+        (lanes, _LANE), imap32(lambda *_: (0, 0)), memory_space=pltpu.VMEM
+    )
+    return [row_spec, row_spec, lane_spec, lane_spec]
+
+
+def _col_spec(R):
+    return pl.BlockSpec(
+        (1, R, _LANE), imap32(lambda b: (b, 0, 0)), memory_space=pltpu.VMEM
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _fft_planes(planes, log_n: int, interpret: bool):
+    """(B, R, 128) limb planes -> transformed, grid over B."""
+    ctx = get_pallas_ctx(log_n)
+    lo, hi = planes
+    B, R, _ = lo.shape
+    spec = _col_spec(R)
+    out_shape = jax.ShapeDtypeStruct((B, R, _LANE), jnp.uint32)
+    return pl.pallas_call(
+        partial(_fwd_kernel, ctx),
+        grid=(B,),
+        out_shape=[out_shape, out_shape],
+        in_specs=_tw_specs(ctx) + [spec, spec],
+        out_specs=[spec, spec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(*ctx.fwd_tw[0], *ctx.fwd_tw[1], lo, hi)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _ifft_planes(planes, log_n: int, interpret: bool):
+    ctx = get_pallas_ctx(log_n)
+    lo, hi = planes
+    B, R, _ = lo.shape
+    spec = _col_spec(R)
+    out_shape = jax.ShapeDtypeStruct((B, R, _LANE), jnp.uint32)
+    return pl.pallas_call(
+        partial(_inv_kernel, ctx),
+        grid=(B,),
+        out_shape=[out_shape, out_shape],
+        in_specs=_itw_specs(ctx) + [spec, spec],
+        out_specs=[spec, spec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(*ctx.inv_tw[0], *ctx.inv_tw[1], lo, hi)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lde_planes(coeff_planes, scale_planes, log_n: int, interpret: bool):
+    """coeffs (B, R, 128) x scale (L, R, 128) -> (B, L, R, 128) planes."""
+    ctx = get_pallas_ctx(log_n)
+    clo, chi = coeff_planes
+    slo, shi = scale_planes
+    B, R, _ = clo.shape
+    L = slo.shape[0]
+    cspec = pl.BlockSpec(
+        (1, R, _LANE), imap32(lambda b, l: (b, 0, 0)), memory_space=pltpu.VMEM
+    )
+    sspec = pl.BlockSpec(
+        (1, R, _LANE), imap32(lambda b, l: (l, 0, 0)), memory_space=pltpu.VMEM
+    )
+    ospec = pl.BlockSpec(
+        (1, 1, R, _LANE),
+        imap32(lambda b, l: (b, l, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((B, L, R, _LANE), jnp.uint32)
+    return pl.pallas_call(
+        partial(_lde_kernel, ctx),
+        grid=(B, L),
+        out_shape=[out_shape, out_shape],
+        in_specs=_tw_specs(ctx) + [sspec, sspec, cspec, cspec],
+        out_specs=[ospec, ospec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(*ctx.fwd_tw[0], *ctx.fwd_tw[1], slo, shi, clo, chi)
+
+
+def _lde_kernel(ctx, trl, trh, tll, tlh, sl, sh, xl, xh, ol, oh):
+    x = limbs.mul((xl[0], xh[0]), (sl[0], sh[0]))
+    x = _fwd_stages_body(ctx, x, (trl[:], trh[:]), (tll[:], tlh[:]))
+    ol[0, 0] = x[0]
+    oh[0, 0] = x[1]
+
+
+# ---------------------------------------------------------------------------
+# Public entry points (uint64 in / uint64 out)
+# ---------------------------------------------------------------------------
+
+MIN_LOG_N = 11  # below this the XLA path's dispatch cost is negligible
+MAX_LOG_N = 16  # above this one column's stage chain exceeds VMEM (2^17
+# forward compiles but the inverse body's extra temporaries OOM the 100 MiB
+# scoped budget; >=2^17 sizes go through the XLA path until the two-level
+# decomposition lands)
+
+# The unrolled stage chain keeps several live column copies; the default
+# 16 MiB scoped-vmem budget is too tight for 2^16+ columns (v5e has 128 MiB
+# physical VMEM — raise the cap rather than splitting the kernel).
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def size_fits(n: int) -> bool:
+    return (1 << MIN_LOG_N) <= n <= (1 << MAX_LOG_N)
+
+
+def _to_planes(a: jax.Array):
+    """(..., n) u64 -> ((B, R, 128) lo, hi), remembering the lead shape."""
+    lead = a.shape[:-1]
+    n = a.shape[-1]
+    flat = a.reshape(-1, n // _LANE, _LANE)
+    return limbs.split(flat), lead
+
+
+def _from_planes(planes, lead, n):
+    return limbs.join(planes).reshape(lead + (n,))
+
+
+def fft_natural_to_bitreversed(a: jax.Array, interpret: bool = False):
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    planes, lead = _to_planes(a)
+    out = _fft_planes(planes, log_n, interpret)
+    return _from_planes(out, lead, n)
+
+
+def ifft_bitreversed_to_natural(a: jax.Array, interpret: bool = False):
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    planes, lead = _to_planes(a)
+    out = _ifft_planes(planes, log_n, interpret)
+    return _from_planes(out, lead, n)
+
+
+def lde_from_monomial(
+    coeffs: jax.Array, scale: jax.Array, interpret: bool = False
+):
+    """coeffs (..., n), scale (lde, n) -> (..., lde, n); fused scale+NTT."""
+    n = coeffs.shape[-1]
+    log_n = n.bit_length() - 1
+    lde = scale.shape[0]
+    planes, lead = _to_planes(coeffs)
+    s_planes = limbs.split(scale.reshape(lde, n // _LANE, _LANE))
+    out = _lde_planes(planes, s_planes, log_n, interpret)
+    return limbs.join(out).reshape(lead + (lde, n))

@@ -27,7 +27,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..field import gl
 from ..field import goldilocks as gf
 from ..field import extension as ext_f
-from ..hashes.poseidon2 import leaf_hash, node_hash
+# the explicitly-XLA sponge entry points: this module's arrays carry
+# NamedShardings for GSPMD to partition, which pallas_call cannot split
+from ..hashes.poseidon2 import leaf_hash_xla as leaf_hash
+from ..hashes.poseidon2 import node_hash_xla as node_hash
 from ..ntt import lde_from_monomial, monomial_from_values, powers_device
 
 
@@ -185,9 +188,12 @@ def _z_from_ratio(ratio):
 def _commit_fragment(copy_vals, lde_factor, cap_size, mesh):
     """Per-column iNTT -> coset LDE -> Merkle digest layers with the
     col->row layout pivot."""
+    from ..utils.pallas_util import force_xla
+
     C, n = copy_vals.shape
-    mono = monomial_from_values(copy_vals)  # column-sharded, no comm
-    lde = lde_from_monomial(mono, lde_factor)  # (C, L, n) still per-column
+    with force_xla():
+        mono = monomial_from_values(copy_vals)  # column-sharded, no comm
+        lde = lde_from_monomial(mono, lde_factor)  # (C, L, n) per-column
     leaves = lde.reshape(C, -1).T  # (L*n, C): the layout pivot
     leaves = jax.lax.with_sharding_constraint(leaves, leaf_sharding(mesh))
     digests = leaf_hash(leaves)  # (L*n, 4) row-sharded

@@ -111,8 +111,24 @@ def _z_and_partials(num_all, den_inv_all):
     return z, (jnp.zeros((0,) + z[0].shape, jnp.uint64),) * 2
 
 
-@jax.jit
 def _ext_prefix_prod(a):
+    """Inclusive ext prefix product along the last axis (fused Pallas
+    block-scan on TPU — opt-in, see goldilocks.batch_inverse; log-doubling
+    XLA elsewhere — bit-identical)."""
+    import os
+
+    from ..utils.pallas_util import pallas_enabled
+
+    if os.environ.get("BOOJUM_TPU_PALLAS_SCAN", "0") == "1" and pallas_enabled():
+        from ..field import pallas_scan
+
+        if pallas_scan.size_fits(a[0].shape[-1]) and a[0].ndim == 1:
+            return pallas_scan.ext_prefix_product(a)
+    return _ext_prefix_prod_xla(a)
+
+
+@jax.jit
+def _ext_prefix_prod_xla(a):
     """Inclusive ext prefix product along the last axis (log-doubling; same
     rationale as gf.prefix_product — associative_scan's graph explodes XLA
     compile time for wide combine fns)."""

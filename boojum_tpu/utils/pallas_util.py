@@ -1,0 +1,67 @@
+"""Shared helpers for Pallas TPU kernels.
+
+The framework enables `jax_enable_x64` globally (the field is 64-bit), which
+makes BlockSpec index maps trace as i64 — Mosaic only legalizes i32 index
+computations. `imap32` wraps an index map so every returned coordinate is cast
+back to int32.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+_FORCE_XLA = [False]
+
+
+class force_xla:
+    """Context manager pinning dispatchers to the XLA path (used while
+    tracing GSPMD-sharded graphs, which pallas_call cannot partition)."""
+
+    def __enter__(self):
+        self._prev = _FORCE_XLA[0]
+        _FORCE_XLA[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _FORCE_XLA[0] = self._prev
+        return False
+
+
+def pallas_enabled() -> bool:
+    """True when the fused TPU kernels should be used.
+
+    Requires the TPU backend, no active prover mesh (the sharded pipeline
+    keeps plain XLA ops so GSPMD can partition them — pallas_call does not
+    split under a NamedSharding), and no BOOJUM_TPU_PALLAS=0 override."""
+    if _FORCE_XLA[0]:
+        return False
+    if os.environ.get("BOOJUM_TPU_PALLAS", "").strip() == "0":
+        return False
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    from ..parallel.sharding import active_mesh
+
+    return active_mesh() is None
+
+
+def _to_i32(v):
+    if isinstance(v, int):
+        return jnp.int32(v)
+    return jax.lax.convert_element_type(v, jnp.int32)
+
+
+def imap32(fn):
+    def wrapped(*args):
+        out = fn(*args)
+        if not isinstance(out, tuple):
+            out = (out,)
+        return tuple(_to_i32(v) for v in out)
+
+    return wrapped

@@ -1,0 +1,171 @@
+"""Parity tests for the u32-limb field forms and the Pallas TPU kernels.
+
+The limb ops are pure jnp and run anywhere; the kernels run in interpret
+mode here (the CPU suite) and as real Mosaic kernels on TPU — dispatchers in
+hashes/poseidon2.py and ntt/ntt.py route to them only on the TPU backend, so
+everything below pins bit-parity between the two implementations.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+# interpret-mode kernel runs compile slowly on XLA:CPU (~30-90s each); the
+# full set runs under BOOJUM_TPU_SLOW_TESTS=1 and on real TPU hardware via
+# the bench + scripts, while the default suite keeps one per kernel family.
+_SLOW = bool(os.environ.get("BOOJUM_TPU_SLOW_TESTS"))
+slow_only = pytest.mark.skipif(
+    not _SLOW, reason="interpret-mode compile heavy; BOOJUM_TPU_SLOW_TESTS=1"
+)
+
+from boojum_tpu.field import gl, limbs
+from boojum_tpu.field import goldilocks as gf
+from boojum_tpu.field import extension as ext
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, gl.P, size=shape, dtype=np.uint64)
+
+
+EDGE = np.array(
+    [0, 1, 2, gl.P - 1, gl.P - 2, 0xFFFFFFFF, 0x100000000, gl.P >> 1],
+    dtype=np.uint64,
+)
+
+
+class TestLimbOps:
+    def setup_method(self, _):
+        a64 = np.concatenate([_rand(1 << 10, 10), EDGE, EDGE])
+        b64 = np.concatenate([_rand(1 << 10, 11), EDGE, EDGE[::-1].copy()])
+        self.a64, self.b64 = jnp.asarray(a64), jnp.asarray(b64)
+        self.a = limbs.split(self.a64)
+        self.b = limbs.split(self.b64)
+
+    def _eq(self, got_pair, want64):
+        assert np.array_equal(
+            np.asarray(limbs.join(got_pair)), np.asarray(want64)
+        )
+
+    def test_add_sub_mul(self):
+        self._eq(limbs.add(self.a, self.b), gf.add(self.a64, self.b64))
+        self._eq(limbs.sub(self.a, self.b), gf.sub(self.a64, self.b64))
+        self._eq(limbs.mul(self.a, self.b), gf.mul(self.a64, self.b64))
+
+    def test_unary(self):
+        self._eq(limbs.sqr(self.a), gf.sqr(self.a64))
+        self._eq(limbs.neg(self.a), gf.neg(self.a64))
+        self._eq(limbs.double(self.a), gf.double(self.a64))
+
+    def test_mul_const(self):
+        c = gl.RADIX_2_SUBGROUP_GENERATOR
+        self._eq(
+            limbs.mul_const(self.a, limbs.const_pair(c)),
+            gf.mul(self.a64, jnp.uint64(c)),
+        )
+
+    def test_ext_mul(self):
+        got = limbs.ext_mul((self.a, self.b), (self.b, self.a))
+        want = ext.mul((self.a64, self.b64), (self.b64, self.a64))
+        for g, w in zip(got, want):
+            self._eq(g, w)
+
+    def test_split_join_roundtrip(self):
+        self._eq(self.a, self.a64)
+
+
+class TestPoseidon2Kernel:
+    def test_permutation_interpret(self):
+        from boojum_tpu.hashes import poseidon2 as p2
+        from boojum_tpu.hashes import pallas_poseidon2 as pp2
+
+        state = jnp.asarray(_rand((256, 12), 20))
+        got = pp2.permutation(state, interpret=True)
+        want = p2.poseidon2_permutation_xla(state)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    @slow_only
+    def test_sponge_interpret(self):
+        from boojum_tpu.hashes import poseidon2 as p2
+        from boojum_tpu.hashes import pallas_poseidon2 as pp2
+
+        for width in (9,) if not _SLOW else (8, 9, 21):
+            vals = jnp.asarray(_rand((256, width), 21))
+            got = pp2.sponge_hash(vals, interpret=True)
+            want = p2.leaf_hash_xla(vals)
+            assert np.array_equal(np.asarray(got), np.asarray(want)), width
+
+    @slow_only
+    def test_node_hash_shape_via_sponge(self):
+        from boojum_tpu.hashes import poseidon2 as p2
+        from boojum_tpu.hashes import pallas_poseidon2 as pp2
+
+        left = jnp.asarray(_rand((256, 4), 22))
+        right = jnp.asarray(_rand((256, 4), 23))
+        got = pp2.sponge_hash(
+            jnp.concatenate([left, right], axis=-1), interpret=True
+        )
+        want = p2.node_hash_xla(left, right)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestNTTKernel:
+    LOG_N = 11  # smallest pallas-dispatched size; exercises row+lane stages
+
+    @slow_only
+    def test_fwd_inv_interpret(self):
+        from boojum_tpu.ntt import ntt
+        from boojum_tpu.ntt import pallas_ntt as pntt
+
+        a = jnp.asarray(_rand((1, 1 << self.LOG_N), 30))
+        want = ntt.fft_natural_to_bitreversed_xla(a)
+        got = pntt.fft_natural_to_bitreversed(a, interpret=True)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+        wanti = ntt.ifft_bitreversed_to_natural_xla(want)
+        goti = pntt.ifft_bitreversed_to_natural(want, interpret=True)
+        assert np.array_equal(np.asarray(goti), np.asarray(wanti))
+
+    @slow_only
+    def test_lde_interpret(self):
+        from boojum_tpu.ntt import ntt
+        from boojum_tpu.ntt import pallas_ntt as pntt
+
+        co = jnp.asarray(_rand((1, 1 << self.LOG_N), 31))
+        want = ntt._lde_from_monomial_jit(co, 4)
+        scale = ntt._lde_scale_cached(
+            self.LOG_N, 4, gl.MULTIPLICATIVE_GENERATOR % gl.P
+        )
+        got = pntt.lde_from_monomial(co, scale, interpret=True)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestScanKernels:
+    @slow_only
+    def test_prefix_and_inverse_interpret(self):
+        from boojum_tpu.field import pallas_scan as ps
+
+        a = jnp.asarray(
+            np.maximum(_rand((2, 1 << 13), 40), np.uint64(1))
+        )
+        got = ps.prefix_product(a, interpret=True)
+        want = gf.prefix_product(a)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+        got = ps.batch_inverse(a, interpret=True)
+        want = gf.batch_inverse_xla(a)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    @slow_only
+    def test_ext_prefix_interpret(self):
+        from boojum_tpu.field import pallas_scan as ps
+        from boojum_tpu.prover import stages
+
+        pair = (
+            jnp.asarray(_rand((1 << 13,), 41)),
+            jnp.asarray(_rand((1 << 13,), 42)),
+        )
+        got = ps.ext_prefix_product(pair, interpret=True)
+        want = stages._ext_prefix_prod_xla(pair)
+        for g, w in zip(got, want):
+            assert np.array_equal(np.asarray(g), np.asarray(w))
