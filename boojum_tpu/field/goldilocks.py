@@ -178,11 +178,9 @@ def batch_inverse(a: jax.Array) -> jax.Array:
     (64,128)-tile sequential grid measured ~10x slower than the XLA scans
     on v5e (carry serialization defeats pipelining) — kept for the kernel
     parity surface until the tile scheme is reworked."""
-    import os
-
     from ..utils.pallas_util import pallas_enabled
 
-    if os.environ.get("BOOJUM_TPU_PALLAS_SCAN", "0") == "1" and pallas_enabled():
+    if pallas_enabled("BOOJUM_TPU_PALLAS_SCAN"):
         from . import pallas_scan
 
         if pallas_scan.size_fits(a.shape[-1]):

@@ -151,6 +151,9 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
 from ..utils.pallas_util import imap32  # noqa: E402
 
+# wide-leaf sponge tiles exceed the default 16 MiB scoped-vmem budget
+_CP = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+
 
 def _smem_spec():
     # explicit block + index map: the default index map traces i64 under the
@@ -178,6 +181,7 @@ def _permute_planes(lo, hi, tile_rows: int, interpret: bool):
         in_specs=[_smem_spec(), spec, spec],
         out_specs=[spec, spec],
         interpret=interpret,
+        compiler_params=None if interpret else _CP,
     )(jnp.asarray(_RC_U32), lo, hi)
 
 
@@ -204,15 +208,29 @@ def _sponge_planes(vlo, vhi, num_chunks: int, tile_rows: int, interpret: bool):
         in_specs=[_smem_spec(), in_spec, in_spec],
         out_specs=[out_spec, out_spec],
         interpret=interpret,
+        compiler_params=None if interpret else _CP,
     )(jnp.asarray(_RC_U32), vlo, vhi)
 
 
 def _pick_tile(R: int, budget_rows: int) -> int:
-    """Largest power-of-two tile <= budget_rows dividing R (min 1)."""
-    t = 1
-    while t * 2 <= min(R, budget_rows):
+    """A legal Mosaic tile for the row axis: divides R (grid = R // tile
+    must cover every output row — a non-divisor would silently leave
+    trailing rows unwritten) AND is a multiple of 8 or R itself (the
+    sublane block rule). Whole-R blocks are always legal."""
+    if R <= budget_rows:
+        return R
+    best = None
+    t = 8
+    while t <= min(R, budget_rows):
+        if R % t == 0:
+            best = t
         t *= 2
-    return t
+    if best is None:
+        raise ValueError(
+            f"no legal tile for R={R} (need R % 8 == 0 when R exceeds the "
+            f"VMEM row budget {budget_rows})"
+        )
+    return best
 
 
 _LANE = 128
@@ -220,7 +238,9 @@ _MIN_BATCH = 1024  # below this the XLA path wins (kernel launch overhead)
 
 
 def batch_fits(n: int) -> bool:
-    return n >= _MIN_BATCH and n % _LANE == 0
+    # n % 1024 guarantees a row count with a legal sublane tile (multiple
+    # of 8) whenever the batch exceeds the per-step VMEM budget
+    return n >= _MIN_BATCH and n % (8 * _LANE) == 0
 
 
 def permutation(state: jax.Array, interpret: bool = False) -> jax.Array:
@@ -248,8 +268,11 @@ def sponge_hash(values: jax.Array, interpret: bool = False) -> jax.Array:
         pad = jnp.zeros((8 * num_chunks - L, R, _LANE), values.dtype)
         planes = jnp.concatenate([planes, pad], axis=0)
     vlo, vhi = limbs.split(planes)
-    # VMEM budget: (L + out + temps) * tile * 128 * 4B * 2 planes
-    budget = max(1, (2 << 20) // max(8 * num_chunks * _LANE * 8, 1))
+    # VMEM budget: (L + out + temps) * tile * 128 * 4B * 2 planes. Floor at
+    # 8 (the minimum legal sublane tile): wide leaves simply use more VMEM
+    # per step — the raised compiler vmem cap covers L up to ~1024, and the
+    # leaf_hash dispatcher falls back to XLA beyond that.
+    budget = max(8, (2 << 20) // max(8 * num_chunks * _LANE * 8, 1))
     tile = _pick_tile(R, budget)
     olo, ohi = _sponge_planes(vlo, vhi, num_chunks, tile, interpret)
     out = limbs.join((olo, ohi))

@@ -164,7 +164,13 @@ def poseidon2_permutation(state: jax.Array) -> jax.Array:
 
 def leaf_hash(values: jax.Array) -> jax.Array:
     """Hash (..., L) field values into (..., 4) leaf digests."""
-    if values.ndim == 2 and _pallas_ready(values.shape[0]):
+    # width cap: beyond ~1024 columns the kernel's minimum (8-row) tile no
+    # longer fits the raised VMEM budget; such commits keep the XLA sponge
+    if (
+        values.ndim == 2
+        and values.shape[1] <= 1024
+        and _pallas_ready(values.shape[0])
+    ):
         from . import pallas_poseidon2 as pp2
 
         return pp2.sponge_hash(values)

@@ -87,13 +87,9 @@ def _pallas_ntt_ready(n: int, ctx) -> bool:
     measured on v5e, the fused butterfly chain runs ~1.7x slower than the
     staged-XLA NTT (the emulated-u64 ops fuse well there); parity is exact,
     so flipping the default is purely a perf decision."""
-    import os
-
-    if os.environ.get("BOOJUM_TPU_PALLAS_NTT", "0") != "1":
-        return False
     from ..utils.pallas_util import pallas_enabled
 
-    if not pallas_enabled():
+    if not pallas_enabled("BOOJUM_TPU_PALLAS_NTT"):
         return False
     from . import pallas_ntt
 
@@ -208,11 +204,7 @@ def _lde_from_monomial_jit(
     log_lde = lde_factor.bit_length() - 1
     assert 1 << log_lde == lde_factor
     ctx = get_ntt_context(log_n)
-    w_full = gl.omega(log_n + log_lde)
-    brev_lde = bitreverse_indices(log_lde)
-    # scale matrix: (lde, n) of shift_j^i, rows ordered by bit-reversed j
-    shifts = [gl.mul(coset % gl.P, gl.pow_(w_full, int(j))) for j in brev_lde]
-    scale = jnp.stack([powers_device(s, n) for s in shifts])  # (lde, n)
+    scale = _lde_scale_cached(log_n, lde_factor, int(coset) % gl.P)
     scaled = gf.mul(coeffs[..., None, :], scale)  # (..., lde, n)
     return fft_natural_to_bitreversed(scaled, ctx)
 

@@ -23,9 +23,11 @@ The forward kernel optionally fuses the coset-scale multiply (LDE: scale by
 shift^i before transforming), saving the (cols, lde, n) scaled intermediate
 the XLA path materializes.
 
-Dispatch: `ntt.py` routes here on TPU for 2^11 <= n <= 2^17 (one column +
-twiddles + temporaries fit VMEM); larger transforms use the two-level
-decomposition in `pallas_ntt4.py`; CPU and tiny sizes keep the XLA path.
+Dispatch: `ntt.py` routes here (opt-in, BOOJUM_TPU_PALLAS_NTT=1) for
+2^11 <= n <= 2^16 — one column's full stage chain fits the VMEM budget up
+to 2^16 (the 2^17 inverse OOMs its scoped allocation); larger transforms
+and CPU keep the staged-XLA path. A two-level (four-step) decomposition
+for >=2^17 is future work.
 """
 
 from __future__ import annotations

@@ -115,11 +115,9 @@ def _ext_prefix_prod(a):
     """Inclusive ext prefix product along the last axis (fused Pallas
     block-scan on TPU — opt-in, see goldilocks.batch_inverse; log-doubling
     XLA elsewhere — bit-identical)."""
-    import os
-
     from ..utils.pallas_util import pallas_enabled
 
-    if os.environ.get("BOOJUM_TPU_PALLAS_SCAN", "0") == "1" and pallas_enabled():
+    if pallas_enabled("BOOJUM_TPU_PALLAS_SCAN"):
         from ..field import pallas_scan
 
         if pallas_scan.size_fits(a[0].shape[-1]) and a[0].ndim == 1:

@@ -31,12 +31,16 @@ class force_xla:
         return False
 
 
-def pallas_enabled() -> bool:
+def pallas_enabled(opt_in_env: str | None = None) -> bool:
     """True when the fused TPU kernels should be used.
 
     Requires the TPU backend, no active prover mesh (the sharded pipeline
     keeps plain XLA ops so GSPMD can partition them — pallas_call does not
-    split under a NamedSharding), and no BOOJUM_TPU_PALLAS=0 override."""
+    split under a NamedSharding), and no BOOJUM_TPU_PALLAS=0 override.
+    With `opt_in_env`, additionally requires that env var to be "1" (used
+    by kernels that currently trail the XLA path and are opt-in)."""
+    if opt_in_env is not None and os.environ.get(opt_in_env, "0") != "1":
+        return False
     if _FORCE_XLA[0]:
         return False
     if os.environ.get("BOOJUM_TPU_PALLAS", "").strip() == "0":
