@@ -117,6 +117,7 @@ def recursive_verify(cs, vk, proof, gates):
     n = vk.trace_len
     log_n = n.bit_length() - 1
     L = vk.fri_lde_factor
+    Q = vk.effective_quotient_degree()
     log_full = log_n + (L.bit_length() - 1)
     Ct = vk.num_copy_cols
     Cg = geometry.num_columns_under_copy_permutation
@@ -138,7 +139,7 @@ def recursive_verify(cs, vk, proof, gates):
 
     num_chunks = len(chunk_columns(Ct, geometry.max_allowed_constraint_degree))
     S = 2 * (1 + (num_chunks - 1)) + 2 * R + 2 * M
-    B = (Ct + W + M) + (Ct + K + TW) + S + 2 * L
+    B = (Ct + W + M) + (Ct + K + TW) + S + 2 * Q
     assert len(proof.values_at_z) == B and len(proof.values_at_z_omega) == 2
     assert len(proof.values_at_0) == R + M
 
@@ -285,7 +286,7 @@ def recursive_verify(cs, vk, proof, gates):
     # T(z)·Z_H(z) == total
     t_at_z = ops.zero()
     z_pows = _PowIter(ops, z_pow_n)
-    for i in range(L):
+    for i in range(Q):
         q_i = _ext_from_pair(ops, q_vals[2 * i], q_vals[2 * i + 1])
         t_at_z = ops.add(t_at_z, ops.mul(q_i, next(z_pows)))
     ops.enforce_equal(total, ops.mul(t_at_z, zh_at_z))
@@ -330,7 +331,7 @@ def recursive_verify(cs, vk, proof, gates):
         assert len(q.witness.leaf_values) == Ct + W + M
         assert len(q.setup.leaf_values) == Ct + K + TW
         assert len(q.stage2.leaf_values) == S
-        assert len(q.quotient.leaf_values) == 2 * L
+        assert len(q.quotient.leaf_values) == 2 * Q
 
         # x = g·ω^brev(idx): nat bit (log-1-j) = idx bit j
         x = _point_from_bits(bops, idx_bits, omega_full, g)

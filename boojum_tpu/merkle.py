@@ -93,27 +93,34 @@ class MerkleTreeWithCap:
     def get_cap(self):
         return list(self._cap_host)
 
+    def proof_gathers(self, leaf_indices):
+        """Dispatch the per-level sibling gathers WITHOUT transferring:
+        returns (lazy device arrays, assemble(levels) -> paths). Lets the
+        prover fuse every oracle's query data into one host transfer."""
+        idxs = np.array(list(leaf_indices), dtype=np.int64)
+        pending = []
+        cur = idxs
+        for layer in self.layers[:-1]:
+            pending.append(layer[jnp.asarray(cur ^ 1)])  # (Q, 4) lazy
+            cur = cur >> 1
+
+        def assemble(levels):
+            return [
+                [tuple(int(x) for x in level[q]) for level in levels]
+                for q in range(len(idxs))
+            ]
+
+        return pending, assemble
+
     def get_proofs(self, leaf_indices):
         """Batched path extraction for many queries: ONE device gather per
         tree level (a (num_queries, 4) slice) instead of per-query
         per-level element reads — behind a network tunnel the round-trips
         dominate, on local hardware it is still fewer, larger transfers.
         Returns a list of paths aligned with leaf_indices."""
-        idxs = np.array(list(leaf_indices), dtype=np.int64)
-        # sibling indices per level are host-computable up front: dispatch
-        # every gather asynchronously, block once at the end
-        pending = []
-        cur = idxs
-        for layer in self.layers[:-1]:
-            pending.append(layer[jnp.asarray(cur ^ 1)])  # (Q, 4) lazy
-            cur = cur >> 1
+        pending, assemble = self.proof_gathers(leaf_indices)
         levels = [np.asarray(x) for x in jax.device_get(pending)]
-        paths = []
-        for q in range(len(idxs)):
-            paths.append(
-                [tuple(int(x) for x in level[q]) for level in levels]
-            )
-        return paths
+        return assemble(levels)
 
     def get_proof(self, leaf_idx: int):
         """Single-query path (see get_proofs for the batched form)."""

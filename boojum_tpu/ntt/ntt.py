@@ -209,6 +209,14 @@ def _lde_from_monomial_jit(
     return fft_natural_to_bitreversed(scaled, ctx)
 
 
+def lde_scale_rows(
+    log_n: int, lde_factor: int, coset: int = gl.MULTIPLICATIVE_GENERATOR
+) -> jax.Array:
+    """Public accessor for the cached (lde, n) coset-scale matrix (rows in
+    bit-reversed coset order) — row c scales monomials onto LDE coset c."""
+    return _lde_scale_cached(log_n, lde_factor, int(coset) % gl.P)
+
+
 @lru_cache(maxsize=None)
 def _lde_scale_cached(log_n: int, lde_factor: int, coset: int) -> jax.Array:
     """(lde, n) scale matrix shift_j^i (rows in bit-reversed coset order)."""

@@ -14,9 +14,20 @@ class ProofConfig:
     # (2^k-to-1 per oracle, reference fri/mod.rs interpolation schedule);
     # None derives the reference-style greedy [3,3,...,rem] schedule
     fri_folding_schedule: list | None = None
+    # quotient evaluation rate (number of size-n cosets the quotient sweep
+    # runs over = number of degree-<n quotient chunks). None derives it from
+    # the circuit's constraint degrees at setup time — DECOUPLED from
+    # fri_lde_factor, as in the reference (prover.rs:259 quotient_degree vs
+    # :313 used_lde_degree): oracles commit at fri_lde_factor while the
+    # sweep streams per-coset at this rate, so e.g. the Era main-VM config
+    # (LDE 2, degree-8 quotient) neither inflates proofs nor HBM.
+    quotient_degree: int | None = None
 
     def __post_init__(self):
         assert self.fri_lde_factor & (self.fri_lde_factor - 1) == 0
         assert self.merkle_tree_cap_size & (self.merkle_tree_cap_size - 1) == 0
         if self.fri_folding_schedule is not None:
             assert all(int(k) >= 1 for k in self.fri_folding_schedule)
+        if self.quotient_degree is not None:
+            assert self.quotient_degree >= 1
+            assert self.quotient_degree & (self.quotient_degree - 1) == 0

@@ -35,6 +35,8 @@ from ..ntt import (
     ext_powers_device,
     eval_monomial_at_ext_point,
     distribute_powers,
+    fft_natural_to_bitreversed,
+    lde_scale_rows,
     get_ntt_context,
     ifft_bitreversed_to_natural,
     lde_from_monomial,
@@ -208,6 +210,15 @@ def _l0_brev(log_n, lde_factor):
     )
 
 
+@jax.jit
+def _coset_eval(mono_stack, scale_row):
+    """Evaluate a (B, n) monomial stack over ONE LDE coset: the scale row is
+    shift_c^i (ntt._lde_scale_cached row c), then a forward NTT. One
+    compiled graph reused for every coset of the streamed quotient sweep."""
+    scaled = gf.mul(mono_stack, scale_row[None, :])
+    return fft_natural_to_bitreversed(scaled)
+
+
 @lru_cache(maxsize=4)
 def _inv_xs_brev(log_n, lde_factor):
     """1/x over the LDE domain, brev order (cached: challenge-independent)."""
@@ -355,95 +366,126 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     t.witness_merkle_tree_cap(s2_tree.get_cap())
     alpha = t.get_ext_challenge()
 
-    # ---- round 3: quotient -----------------------------------------------
+    # ---- round 3: quotient (streamed per coset at rate Q) ----------------
+    # The sweep runs over Q = vk.quotient_degree cosets while every oracle
+    # commits at rate L — the reference's used_lde_degree vs fri_lde_factor
+    # split (prover.rs:313, setup.rs:1187 subset_for_degree). Streaming one
+    # coset at a time bounds transient HBM to (columns, n) regardless of Q,
+    # which is what lets 2^20-row traces prove at the Era commit rate L=2.
     clock.start("round3_quotient")
+    Q = setup.vk.effective_quotient_degree()
     wit_lde_all = wit_lde.reshape(Ct + W + M, N)
-    copy_lde_flat = wit_lde_all[:Ct]
-    gate_wit_lde = wit_lde_all[Ct : Ct + W] if W else None
     setup_lde_flat = shard_cols(setup.setup_lde.reshape(Ct + K + TW, N))
-    sigma_lde_flat = setup_lde_flat[:Ct]
-    const_lde_flat = setup_lde_flat[Ct : Ct + K]
-    table_lde_flat = setup_lde_flat[Ct + K :]
-    xs_lde = _domain_xs_brev(log_n, L)
-    l0 = _l0_brev(log_n, L)
     s2_lde_flat = s2_lde.reshape(-1, N)
-    z_lde = (s2_lde_flat[0], s2_lde_flat[1])
+    xs_lde = _domain_xs_brev(log_n, L)
     omega = gl.omega(log_n)
     z_shift_mono = (
         distribute_powers(s2_mono[0], omega),
         distribute_powers(s2_mono[1], omega),
     )
-    z_shift_lde = tuple(
-        lde_from_monomial(z_shift_mono[i], L).reshape(N) for i in (0, 1)
+    S_cols = s2_mono.shape[0]
+    sweep_mono = jnp.concatenate(
+        [
+            wit_mono,
+            setup.setup_monomials,
+            s2_mono,
+            z_shift_mono[0][None, :],
+            z_shift_mono[1][None, :],
+        ],
+        axis=0,
     )
-    partial_ldes = [
-        (s2_lde_flat[2 + 2 * j], s2_lde_flat[3 + 2 * j])
-        for j in range(num_partials)
-    ]
+    off_setup = Ct + W + M
+    off_s2 = off_setup + Ct + K + TW
+    off_zs = off_s2 + S_cols
+
+    xs_q = _domain_xs_brev(log_n, Q)
+    l0_q = _l0_brev(log_n, Q)
+    zh_inv_q = _vanishing_inv_brev(log_n, Q)
+    scale_q = lde_scale_rows(log_n, Q)
 
     total_alpha_terms = (
         num_gate_sweep_terms(assembly)
         + 1 + len(chunks)
         + ((R_args + 1) if lookups else 0)
     )
-    alpha_pows = AlphaPows(alpha, total_alpha_terms)
-    acc = gate_terms_contribution(
-        assembly, setup.selector_paths, copy_lde_flat[:Cg], gate_wit_lde,
-        const_lde_flat, alpha_pows, (N,),
-    )
-    cp_acc = copy_permutation_quotient_terms(
-        z_lde, z_shift_lde, partial_ldes, chunks, copy_lde_flat,
-        sigma_lde_flat, setup.non_residues, xs_lde, l0, beta, gamma,
-        alpha_pows,
-    )
-    acc = cp_acc if acc is None else ext_f.add(acc, cp_acc)
-    if lookups:
-        ab_off = 2 + 2 * num_partials
-        a_ldes = [
-            (s2_lde_flat[ab_off + 2 * i], s2_lde_flat[ab_off + 2 * i + 1])
-            for i in range(R_args)
-        ]
-        b_lde = (
-            s2_lde_flat[ab_off + 2 * R_args],
-            s2_lde_flat[ab_off + 2 * R_args + 1],
+    if lookups and lk_mode == "general":
+        from .stages import (
+            lookup_quotient_terms_general,
+            selector_poly_lde,
         )
-        if lk_mode == "specialized":
-            lk_acc = lookup_quotient_terms(
-                a_ldes, b_lde, copy_lde_flat[Cg:], const_lde_flat[K - 1],
-                table_lde_flat, wit_lde_all[Ct + W], lookup_beta,
-                lookup_gamma, R_args, lp.width, alpha_pows,
-            )
-        else:
-            from .stages import (
-                lookup_quotient_terms_general,
-                selector_poly_lde,
-            )
 
-            mk_path = setup.selector_paths[assembly.lookup_marker_gid()]
-            sel_lde = selector_poly_lde(const_lde_flat, mk_path)
-            if sel_lde is None:
-                sel_lde = jnp.ones((N,), jnp.uint64)
-            lk_acc = lookup_quotient_terms_general(
-                a_ldes, b_lde, copy_lde_flat[:Cg],
-                const_lde_flat[len(mk_path)], table_lde_flat,
-                wit_lde_all[Ct + W], sel_lde, lookup_beta, lookup_gamma,
-                R_args, lp.width, alpha_pows,
+        mk_path = setup.selector_paths[assembly.lookup_marker_gid()]
+
+    T_parts0, T_parts1 = [], []
+    for c in range(Q):
+        vals = _coset_eval(sweep_mono, scale_q[c])  # (B_stack, n)
+        wit_v = vals[:off_setup]
+        copy_v = wit_v[:Ct]
+        gate_wit_v = wit_v[Ct : Ct + W] if W else None
+        setup_v = vals[off_setup:off_s2]
+        sigma_v = setup_v[:Ct]
+        const_v = setup_v[Ct : Ct + K]
+        table_v = setup_v[Ct + K :]
+        s2_v = vals[off_s2:off_zs]
+        z_v = (s2_v[0], s2_v[1])
+        z_shift_v = (vals[off_zs], vals[off_zs + 1])
+        partial_v = [
+            (s2_v[2 + 2 * j], s2_v[3 + 2 * j]) for j in range(num_partials)
+        ]
+        sl = slice(c * n, (c + 1) * n)
+        # fresh per coset: the per-TERM challenge sequence is identical on
+        # every coset (same order the verifier replays)
+        alpha_pows = AlphaPows(alpha, total_alpha_terms)
+        acc = gate_terms_contribution(
+            assembly, setup.selector_paths, copy_v[:Cg], gate_wit_v,
+            const_v, alpha_pows,
+        )
+        cp_acc = copy_permutation_quotient_terms(
+            z_v, z_shift_v, partial_v, chunks, copy_v, sigma_v,
+            setup.non_residues, xs_q[sl], l0_q[sl], beta, gamma, alpha_pows,
+        )
+        acc = cp_acc if acc is None else ext_f.add(acc, cp_acc)
+        if lookups:
+            ab_off = 2 + 2 * num_partials
+            a_v = [
+                (s2_v[ab_off + 2 * i], s2_v[ab_off + 2 * i + 1])
+                for i in range(R_args)
+            ]
+            b_v = (
+                s2_v[ab_off + 2 * R_args],
+                s2_v[ab_off + 2 * R_args + 1],
             )
-        acc = ext_f.add(acc, lk_acc)
-    zh_inv = _vanishing_inv_brev(log_n, L)
-    T = (gf.mul(acc[0], zh_inv), gf.mul(acc[1], zh_inv))
-    # interpolate over the full LDE coset to monomial form
+            if lk_mode == "specialized":
+                lk_acc = lookup_quotient_terms(
+                    a_v, b_v, copy_v[Cg:], const_v[K - 1], table_v,
+                    wit_v[Ct + W], lookup_beta, lookup_gamma, R_args,
+                    lp.width, alpha_pows,
+                )
+            else:
+                sel_v = selector_poly_lde(const_v, mk_path)
+                if sel_v is None:
+                    sel_v = jnp.ones((n,), jnp.uint64)
+                lk_acc = lookup_quotient_terms_general(
+                    a_v, b_v, copy_v[:Cg], const_v[len(mk_path)], table_v,
+                    wit_v[Ct + W], sel_v, lookup_beta, lookup_gamma,
+                    R_args, lp.width, alpha_pows,
+                )
+            acc = ext_f.add(acc, lk_acc)
+        T_parts0.append(gf.mul(acc[0], zh_inv_q[sl]))
+        T_parts1.append(gf.mul(acc[1], zh_inv_q[sl]))
+    T = (jnp.concatenate(T_parts0), jnp.concatenate(T_parts1))
+    # interpolate over the full rate-Q domain to monomial form
     g_inv = gl.inv(gl.MULTIPLICATIVE_GENERATOR)
     T_mono = tuple(
         distribute_powers(ifft_bitreversed_to_natural(T[i]), g_inv)
         for i in (0, 1)
     )
-    # split into L chunks of degree < n, interleave (c0, c1)
+    # split into Q chunks of degree < n, interleave (c0, c1); COMMIT at L
     q_cols = []
-    for i in range(L):
+    for i in range(Q):
         for comp in (0, 1):
             q_cols.append(T_mono[comp][i * n : (i + 1) * n])
-    q_mono = shard_cols(jnp.stack(q_cols))  # (2L, n) already monomial
+    q_mono = shard_cols(jnp.stack(q_cols))  # (2Q, n) already monomial
     q_lde = lde_from_monomial(q_mono, L)
     q_tree, _ = _commit_columns(q_lde, cap)
     t.witness_merkle_tree_cap(q_tree.get_cap())
@@ -489,7 +531,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         wit_lde_all,
         setup_lde_flat,
         s2_lde_flat,
-        q_lde.reshape(2 * L, N),
+        q_lde.reshape(2 * Q, N),
     ]
     # 1/(x - z), 1/(x - z*omega) over the domain (ext)
     x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),
@@ -564,9 +606,57 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     idxs = [bs.get_index(t, log_full) for _ in range(config.num_queries)]
     idx_dev = jnp.asarray(np.array(idxs, dtype=np.int64))
 
-    def oracle_queries(leaves_cols, tree):
-        vals = np.asarray(leaves_cols[:, idx_dev])  # (B, Q) one gather
-        paths = tree.get_proofs(idxs)
+    # Dispatch EVERY query gather (leaf rows + all tree path levels, all
+    # oracles) lazily, fuse them into one device-side concatenation, and
+    # pay ONE host transfer — behind a network tunnel the per-transfer
+    # round-trip otherwise dominates the whole query phase.
+    fetch_parts: list = []
+
+    def _defer(arr):
+        fetch_parts.append(arr.reshape(-1))
+        return len(fetch_parts) - 1, arr.shape
+
+    def _defer_oracle(leaves_cols, tree):
+        vals_h = _defer(leaves_cols[:, idx_dev])  # (B, Q) lazy
+        pending, assemble = tree.proof_gathers(idxs)
+        level_hs = [_defer(p) for p in pending]
+        return vals_h, level_hs, assemble
+
+    oracle_handles = [
+        _defer_oracle(wit_lde_all, wit_tree),
+        _defer_oracle(s2_lde_flat, s2_tree),
+        _defer_oracle(q_lde.reshape(2 * Q, N), q_tree),
+        _defer_oracle(setup_lde_flat, setup.setup_tree),
+    ]
+    fri_handles = []
+    fidxs = np.array(idxs, dtype=np.int64)
+    for r, tree in enumerate(fri.trees):
+        k = fri.schedule[r]
+        block = 1 << k
+        leaf_idx = fidxs >> k
+        v0, v1 = fri.values[r]
+        rows = (
+            leaf_idx[:, None] * block + np.arange(block)[None, :]
+        ).reshape(-1)
+        rows_dev = jnp.asarray(rows)
+        gathered_h = _defer(jnp.stack([v0[rows_dev], v1[rows_dev]]))
+        pending, assemble = tree.proof_gathers([int(p) for p in leaf_idx])
+        level_hs = [_defer(p) for p in pending]
+        fri_handles.append((gathered_h, level_hs, assemble, block))
+        fidxs = leaf_idx
+
+    # the single transfer
+    flat = np.asarray(jnp.concatenate(fetch_parts))
+    offs = np.cumsum([0] + [int(p.size) for p in fetch_parts])
+
+    def _take(handle):
+        i, shape = handle
+        return flat[offs[i] : offs[i + 1]].reshape(shape)
+
+    def _oracle_queries(handle):
+        vals_h, level_hs, assemble = handle
+        vals = _take(vals_h)
+        paths = assemble([_take(h) for h in level_hs])
         return [
             OracleQuery(
                 leaf_values=[int(x) for x in vals[:, q]], path=paths[q]
@@ -574,27 +664,12 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             for q in range(len(idxs))
         ]
 
-    wit_qs = oracle_queries(wit_lde_all, wit_tree)
-    s2_qs = oracle_queries(s2_lde_flat, s2_tree)
-    q_qs = oracle_queries(q_lde.reshape(2 * L, N), q_tree)
-    setup_qs = oracle_queries(setup_lde_flat, setup.setup_tree)
+    wit_qs, s2_qs, q_qs, setup_qs = map(_oracle_queries, oracle_handles)
     fri_qs_per_round = []
-    fidxs = np.array(idxs, dtype=np.int64)
-    for r, tree in enumerate(fri.trees):
-        k = fri.schedule[r]
-        block = 1 << k
-        leaf_idx = fidxs >> k
-        v0, v1 = fri.values[r]
-        # one gather per oracle: every query's whole 2^k-point leaf
-        rows = (
-            leaf_idx[:, None] * block + np.arange(block)[None, :]
-        ).reshape(-1)
-        rows_dev = jnp.asarray(rows)
-        gathered = np.asarray(
-            jnp.stack([v0[rows_dev], v1[rows_dev]])
-        )  # (2, Q*block)
-        Q = len(idxs)
-        paths = tree.get_proofs([int(p) for p in leaf_idx])
+    num_q = len(idxs)
+    for gathered_h, level_hs, assemble, block in fri_handles:
+        gathered = _take(gathered_h)  # (2, Q*block)
+        paths = assemble([_take(h) for h in level_hs])
         fri_qs_per_round.append(
             [
                 OracleQuery(
@@ -605,10 +680,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                     ],
                     path=paths[q],
                 )
-                for q in range(Q)
+                for q in range(num_q)
             ]
         )
-        fidxs = leaf_idx
     queries = [
         SingleRoundQueries(
             witness=wit_qs[q],
@@ -634,6 +708,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         pow_challenge=pow_nonce,
         config={
             "fri_lde_factor": L,
+            "quotient_degree": Q,
             "merkle_tree_cap_size": cap,
             "num_queries": config.num_queries,
             "pow_bits": config.pow_bits,

@@ -159,6 +159,11 @@ class VerificationKey:
     lookup_params: object = None
     num_lookup_tables: int = 0
     fri_folding_schedule: list | None = None
+    # quotient chunk count / sweep rate; None (legacy keys) = fri_lde_factor
+    quotient_degree: int | None = None
+
+    def effective_quotient_degree(self) -> int:
+        return self.quotient_degree or self.fri_lde_factor
 
     def to_dict(self):
         from dataclasses import asdict
@@ -166,6 +171,7 @@ class VerificationKey:
         d = {
             "trace_len": self.trace_len,
             "fri_lde_factor": self.fri_lde_factor,
+            "quotient_degree": self.quotient_degree,
             "cap_size": self.cap_size,
             "num_queries": self.num_queries,
             "pow_bits": self.pow_bits,
@@ -225,13 +231,22 @@ def generate_setup(assembly, config) -> SetupData:
         "fri_final_degree must be below the trace length (at least one fold)"
     )
     tree, selector_paths = build_selector_tree(assembly.gates)
-    # masked-constraint degree must fit the quotient LDE domain: per-gate
-    # (own selector depth + gate degree) <= L — the degree-aware tree keeps
-    # high-degree gates shallow so this is tight, not worst-case.
+    # masked-constraint degree must fit the QUOTIENT evaluation domain
+    # (quotient_degree cosets) — decoupled from the commitment rate
+    # fri_lde_factor, reference prover.rs:230-259 quotient_degree_from_
+    # gate_terms vs proof_config.fri_lde_factor. The degree-aware tree
+    # keeps high-degree gates shallow so the bound is tight.
     tree_degree, tree_constants = tree.compute_stats()
-    assert tree_degree <= config.fri_lde_factor, (
-        f"selector tree degree {tree_degree} exceeds fri_lde_factor "
-        f"{config.fri_lde_factor}"
+    degree_bound = max(
+        tree_degree,
+        assembly.geometry.max_allowed_constraint_degree + 1,
+        1,
+    )
+    derived_q = 1 << (degree_bound - 1).bit_length()  # next power of two
+    quotient_degree = config.quotient_degree or derived_q
+    assert tree_degree <= quotient_degree, (
+        f"selector tree degree {tree_degree} exceeds quotient_degree "
+        f"{quotient_degree}"
     )
     assert tree_constants <= assembly.geometry.num_constant_columns, (
         f"selector tree needs {tree_constants} constant columns, geometry "
@@ -239,8 +254,8 @@ def generate_setup(assembly, config) -> SetupData:
     )
     assert (
         assembly.geometry.max_allowed_constraint_degree + 1
-        <= config.fri_lde_factor
-    ), "copy-permutation chunk degree exceeds fri_lde_factor"
+        <= quotient_degree
+    ), "copy-permutation chunk degree exceeds quotient_degree"
     full_placement = np.concatenate(
         [assembly.copy_placement, assembly.lookup_placement], axis=0
     )
@@ -266,6 +281,7 @@ def generate_setup(assembly, config) -> SetupData:
         geometry=assembly.geometry,
         trace_len=n,
         fri_lde_factor=config.fri_lde_factor,
+        quotient_degree=quotient_degree,
         cap_size=config.merkle_tree_cap_size,
         num_queries=config.num_queries,
         pow_bits=config.pow_bits,

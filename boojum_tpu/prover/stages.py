@@ -263,7 +263,7 @@ def num_gate_sweep_terms(assembly) -> int:
 
 def gate_terms_contribution(
     assembly, selector_paths, copy_lde_flat, wit_lde_flat, const_lde_flat,
-    alpha_pows: AlphaPows, domain_shape,
+    alpha_pows: AlphaPows,
 ):
     """Sum over gates/instances/terms of alpha^t * selector_g * term.
 

@@ -56,6 +56,7 @@ def verify(vk, proof, gates) -> bool:
     n = vk.trace_len
     log_n = n.bit_length() - 1
     L = vk.fri_lde_factor
+    Q = vk.effective_quotient_degree()
     log_full = log_n + (L.bit_length() - 1)
     N = n * L
     Ct = vk.num_copy_cols  # ALL columns under copy permutation
@@ -85,7 +86,7 @@ def verify(vk, proof, gates) -> bool:
 
     num_chunks = len(chunk_columns(Ct, geometry.max_allowed_constraint_degree))
     S = 2 * (1 + (num_chunks - 1)) + 2 * R + 2 * M  # z, partials, A_i, B
-    B = (Ct + W + M) + (Ct + K + TW) + S + 2 * L
+    B = (Ct + W + M) + (Ct + K + TW) + S + 2 * Q
     if len(proof.values_at_z) != B or len(proof.values_at_z_omega) != 2:
         return False
     if len(proof.values_at_0) != R + M:
@@ -269,7 +270,7 @@ def verify(vk, proof, gates) -> bool:
 
     # T(z) from quotient chunks: sum z^{i n} * q_i(z)
     t_at_z = ext_f.ZERO_S
-    for i in range(L):
+    for i in range(Q):
         q_i = ext_from_pair(q_vals[2 * i], q_vals[2 * i + 1])
         t_at_z = ext_f.add_s(
             t_at_z, ext_f.mul_s(q_i, ext_f.pow_s(z_chal, i * n))
@@ -309,7 +310,7 @@ def verify(vk, proof, gates) -> bool:
             len(q.witness.leaf_values) != Ct + W + M
             or len(q.setup.leaf_values) != Ct + K + TW
             or len(q.stage2.leaf_values) != S
-            or len(q.quotient.leaf_values) != 2 * L
+            or len(q.quotient.leaf_values) != 2 * Q
         ):
             return False
         # recompute the DEEP codeword value h(x) at the queried point

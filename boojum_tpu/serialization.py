@@ -48,6 +48,11 @@ def vk_from_json(s: str) -> VerificationKey:
         lookup_params=lookup_params,
         num_lookup_tables=int(d.get("num_lookup_tables", 0)),
         fri_folding_schedule=d.get("fri_folding_schedule"),
+        quotient_degree=(
+            int(d["quotient_degree"])
+            if d.get("quotient_degree") is not None
+            else None
+        ),
     )
 
 
