@@ -16,6 +16,12 @@ Environment knobs:
   BENCH_SHA_BYTES = message size (default 8192)
   BENCH_LOG_N = fma-mode trace log2 size (default 10)
   BENCH_REPS = timed repetitions (default 1)
+  BENCH_LDE = FRI commit rate override (default 8 sha / 4 fma; the
+      quotient still evaluates at the degree-derived rate — BENCH_LDE=2 is
+      the Era main-VM golden-proof commit rate and what 2^20-row traces
+      use to stay inside HBM)
+  BENCH_QUERIES = FRI query count (default 50; the reference's LDE-2
+      golden proof uses 100)
 """
 
 import json
@@ -78,10 +84,13 @@ def main():
 
     circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
     reps = int(os.environ.get("BENCH_REPS", "1"))
+    lde = int(
+        os.environ.get("BENCH_LDE", "8" if circuit == "sha256" else "4")
+    )
     config = ProofConfig(
-        fri_lde_factor=8 if circuit == "sha256" else 4,
+        fri_lde_factor=lde,
         merkle_tree_cap_size=16,
-        num_queries=50,
+        num_queries=int(os.environ.get("BENCH_QUERIES", "50")),
         pow_bits=0,
         fri_final_degree=16,
     )

@@ -124,6 +124,10 @@ def recursive_verify(cs, vk, proof, gates):
     W = vk.num_wit_cols
     lp = vk.lookup_params
     lookups = lp is not None and lp.is_enabled
+    assert getattr(vk, "transcript", "poseidon2") == "poseidon2", (
+        "the in-circuit verifier replays the Poseidon2 transcript only "
+        "(the reference's recursion-compatible transcript configuration)"
+    )
     assert not (lookups and not lp.use_specialized_columns), (
         "the in-circuit verifier supports specialized-columns lookups only "
         "(general-purpose-columns recursion is a round-3 item)"

@@ -1,0 +1,127 @@
+"""Legacy Poseidon permutation (Goldilocks, t=12, x^7) — batched device +
+host scalar.
+
+Counterpart of `/root/reference/src/implementations/poseidon_goldilocks.rs`
+(+ `poseidon_goldilocks_naive.rs`, `suggested_mds.rs`): the ORIGINAL Poseidon
+round function the reference keeps alongside Poseidon2 (Plonky2-compatible —
+same MDS and round constants, so proofs interoperate with Plonky2-era
+tooling). Parameters: width 12 (rate 8 / capacity 4), S-box x^7, 4 full +
+22 partial + 4 full rounds, every round = add-constants -> S-box (all lanes
+in full rounds, lane 0 in partial) -> MDS.
+
+The MDS matrix is the circulant of powers of two with exponents
+[0,0,1,0,3,5,1,8,12,3,16,10] (suggested_mds.rs:11 MDS_MATRIX_EXPS):
+M[r][c] = 2^exps[(c - r) mod 12]. Round constants are the shared Plonky2
+table (`poseidon2_params.ALL_ROUND_CONSTANTS` — Poseidon2 reuses them,
+reference poseidon2/params.rs). On device the MDS row sums run as 12
+shift-multiplied modular adds over whole (..., 12) batches; the reference's
+precomputed-round "optimized" variant is a pure CPU scheduling trick whose
+outputs equal the naive spec (its own test_valid_transformation asserts so),
+so this implements the spec form.
+
+Sponge semantics (rate 8 / cap 4, overwrite mode) match the Poseidon2
+sponge so either permutation can drive transcripts and tree hashing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import goldilocks as gf
+from . import poseidon2_params as params
+from .poseidon2 import Poseidon2SpongeHost, _sponge_hash_device
+
+_RC = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
+MDS_MATRIX_EXPS = [0, 0, 1, 0, 3, 5, 1, 8, 12, 3, 16, 10]
+
+
+def _sbox7(x):
+    x2 = gf.sqr(x)
+    x3 = gf.mul(x2, x)
+    return gf.mul(gf.sqr(x2), x3)
+
+
+def _mds_mul(state):
+    """(..., 12) -> M · state with the power-of-two circulant."""
+    cols = [state[..., i] for i in range(12)]
+    out = []
+    for r in range(12):
+        acc = None
+        for c in range(12):
+            term = gf.mul_small(cols[c], 1 << MDS_MATRIX_EXPS[(c - r) % 12])
+            acc = term if acc is None else gf.add(acc, term)
+        out.append(acc)
+    return jnp.stack(out, axis=-1)
+
+
+@jax.jit
+def poseidon_permutation(state: jax.Array) -> jax.Array:
+    """Batched legacy Poseidon permutation on (..., 12) uint64 arrays."""
+    rc = jnp.asarray(_RC)
+
+    def full_round(r, s):
+        s = gf.add(s, rc[r])
+        s = _sbox7(s)
+        return _mds_mul(s)
+
+    def partial_round(r, s):
+        s = gf.add(s, rc[r])
+        el0 = _sbox7(s[..., 0])
+        s = jnp.concatenate([el0[..., None], s[..., 1:]], axis=-1)
+        return _mds_mul(s)
+
+    state = jax.lax.fori_loop(0, 4, full_round, state)
+    state = jax.lax.fori_loop(4, 26, partial_round, state)
+    state = jax.lax.fori_loop(26, 30, full_round, state)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Host scalar mirror (python ints) — transcripts & verification
+# ---------------------------------------------------------------------------
+
+
+def _sbox7_s(x):
+    x2 = gl.sqr(x)
+    return gl.mul(gl.sqr(x2), gl.mul(x2, x))
+
+
+def _mds_mul_s(s):
+    out = []
+    for r in range(12):
+        acc = 0
+        for c in range(12):
+            acc = gl.add(
+                acc, gl.mul(s[c], 1 << MDS_MATRIX_EXPS[(c - r) % 12])
+            )
+        out.append(acc)
+    return out
+
+
+def poseidon_permutation_host(state: list) -> list:
+    s = [int(v) for v in state]
+    for r in range(30):
+        s = [gl.add(v, int(_RC[r, i])) for i, v in enumerate(s)]
+        if 4 <= r < 26:
+            s[0] = _sbox7_s(s[0])
+        else:
+            s = [_sbox7_s(v) for v in s]
+        s = _mds_mul_s(s)
+    return s
+
+
+class PoseidonSpongeHost(Poseidon2SpongeHost):
+    """Overwrite-mode sponge (rate 8 / cap 4) over the legacy permutation —
+    same absorb/finalize semantics, permutation swapped via the hook."""
+
+    _PERMUTATION = staticmethod(poseidon_permutation_host)
+
+
+@jax.jit
+def leaf_hash(values: jax.Array) -> jax.Array:
+    """Hash (..., L) field values into (..., 4) digests (legacy Poseidon
+    overwrite-mode sponge — the device twin of PoseidonSpongeHost)."""
+    return _sponge_hash_device(values, poseidon_permutation)

@@ -103,14 +103,11 @@ def poseidon2_permutation_xla(state: jax.Array) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@jax.jit
-def leaf_hash_xla(values: jax.Array) -> jax.Array:
-    """Hash (..., L) field values into (..., 4) leaf digests.
-
-    Overwrite-mode sponge: each full 8-chunk overwrites the rate portion then
-    permutes; a trailing partial chunk is zero-padded (finalize semantics of
-    the reference sponge).
-    """
+def _sponge_hash_device(values: jax.Array, permutation) -> jax.Array:
+    """Overwrite-mode sponge over (..., L) -> (..., 4) for any width-12
+    permutation: each full 8-chunk overwrites the rate portion then
+    permutes; a trailing partial chunk is zero-padded (finalize semantics
+    of the reference sponge)."""
     lead = values.shape[:-1]
     L = values.shape[-1]
     state = jnp.zeros(lead + (12,), jnp.uint64)
@@ -118,14 +115,20 @@ def leaf_hash_xla(values: jax.Array) -> jax.Array:
     for c in range(full):
         chunk = values[..., 8 * c : 8 * c + 8]
         state = jnp.concatenate([chunk, state[..., 8:]], axis=-1)
-        state = poseidon2_permutation_xla(state)
+        state = permutation(state)
     rem = L - 8 * full
     if rem > 0:
         chunk = values[..., 8 * full :]
         pad = jnp.zeros(lead + (8 - rem,), jnp.uint64)
         state = jnp.concatenate([chunk, pad, state[..., 8:]], axis=-1)
-        state = poseidon2_permutation_xla(state)
+        state = permutation(state)
     return state[..., :4]
+
+
+@jax.jit
+def leaf_hash_xla(values: jax.Array) -> jax.Array:
+    """Hash (..., L) field values into (..., 4) leaf digests."""
+    return _sponge_hash_device(values, poseidon2_permutation_xla)
 
 
 @jax.jit
@@ -239,10 +242,12 @@ def poseidon2_permutation_host(state: list) -> list:
 
 
 class Poseidon2SpongeHost:
-    """Overwrite-mode sponge over python ints (transcripts, path verification)."""
+    """Overwrite-mode sponge over python ints (transcripts, path
+    verification). Subclasses swap the permutation via _PERMUTATION."""
 
     RATE = 8
     CAPACITY = 4
+    _PERMUTATION = staticmethod(poseidon2_permutation_host)
 
     def __init__(self):
         self.state = [0] * 12
@@ -253,25 +258,25 @@ class Poseidon2SpongeHost:
         while len(self.buffer) >= 8:
             chunk, self.buffer = self.buffer[:8], self.buffer[8:]
             self.state[:8] = chunk
-            self.state = poseidon2_permutation_host(self.state)
+            self.state = self._PERMUTATION(self.state)
 
     def finalize(self, n=4):
         if self.buffer:
             self.state[: len(self.buffer)] = self.buffer
             for i in range(len(self.buffer), 8):
                 self.state[i] = 0
-            self.state = poseidon2_permutation_host(self.state)
+            self.state = self._PERMUTATION(self.state)
             self.buffer = []
         return self.state[:n]
 
-    @staticmethod
-    def hash_leaf(values, n=4):
-        sp = Poseidon2SpongeHost()
+    @classmethod
+    def hash_leaf(cls, values, n=4):
+        sp = cls()
         sp.absorb(values)
         return sp.finalize(n)
 
-    @staticmethod
-    def hash_node(left, right):
-        sp = Poseidon2SpongeHost()
+    @classmethod
+    def hash_node(cls, left, right):
+        sp = cls()
         sp.absorb(list(left) + list(right))
         return sp.finalize(4)

@@ -22,12 +22,19 @@ class ProofConfig:
     # sweep streams per-coset at this rate, so e.g. the Era main-VM config
     # (LDE 2, degree-8 quotient) neither inflates proofs nor HBM.
     quotient_degree: int | None = None
+    # Fiat-Shamir transcript kind: poseidon2 (default, recursion-compatible)
+    # | poseidon (legacy round function) | blake2s | keccak256 (reference
+    # transcript.rs:48,155,264 — the tree hasher stays Poseidon2)
+    transcript: str = "poseidon2"
 
     def __post_init__(self):
         assert self.fri_lde_factor & (self.fri_lde_factor - 1) == 0
         assert self.merkle_tree_cap_size & (self.merkle_tree_cap_size - 1) == 0
         if self.fri_folding_schedule is not None:
             assert all(int(k) >= 1 for k in self.fri_folding_schedule)
+        from ..transcript import TRANSCRIPTS
+
+        assert self.transcript in TRANSCRIPTS, self.transcript
         if self.quotient_degree is not None:
             assert self.quotient_degree >= 1
             assert self.quotient_degree & (self.quotient_degree - 1) == 0

@@ -43,7 +43,7 @@ from ..ntt import (
     monomial_from_values,
     powers_device,
 )
-from ..transcript import BitSource, Poseidon2Transcript
+from ..transcript import BitSource, make_transcript
 from .config import ProofConfig
 from .fri import fri_prove
 from .pow import pow_grind
@@ -279,7 +279,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     lp = assembly.lookup_params
     TW = (lp.width + 1) if lookups else 0  # table setup columns
 
-    t = Poseidon2Transcript()
+    t = make_transcript(setup.vk.transcript)
     t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
     pi_values = [v for (_c, _r, v) in assembly.public_inputs]
     t.witness_field_elements(pi_values)

@@ -161,6 +161,8 @@ class VerificationKey:
     fri_folding_schedule: list | None = None
     # quotient chunk count / sweep rate; None (legacy keys) = fri_lde_factor
     quotient_degree: int | None = None
+    # Fiat-Shamir transcript kind the proof/verifier must replay
+    transcript: str = "poseidon2"
 
     def effective_quotient_degree(self) -> int:
         return self.quotient_degree or self.fri_lde_factor
@@ -172,6 +174,7 @@ class VerificationKey:
             "trace_len": self.trace_len,
             "fri_lde_factor": self.fri_lde_factor,
             "quotient_degree": self.quotient_degree,
+            "transcript": self.transcript,
             "cap_size": self.cap_size,
             "num_queries": self.num_queries,
             "pow_bits": self.pow_bits,
@@ -282,6 +285,7 @@ def generate_setup(assembly, config) -> SetupData:
         trace_len=n,
         fri_lde_factor=config.fri_lde_factor,
         quotient_degree=quotient_degree,
+        transcript=getattr(config, "transcript", "poseidon2"),
         cap_size=config.merkle_tree_cap_size,
         num_queries=config.num_queries,
         pow_bits=config.pow_bits,

@@ -14,7 +14,7 @@ from __future__ import annotations
 from ..field import gl
 from ..field import extension as ext_f
 from ..merkle import verify_proof_over_cap
-from ..transcript import BitSource, Poseidon2Transcript
+from ..transcript import BitSource, make_transcript
 from ..cs.field_like import ExtScalarOps
 from ..cs.gates.base import RowView, TermsCollector
 from .fri import fri_verify_queries, INV2
@@ -93,7 +93,7 @@ def verify(vk, proof, gates) -> bool:
         return False
 
     # ---- transcript replay ------------------------------------------------
-    t = Poseidon2Transcript()
+    t = make_transcript(getattr(vk, 'transcript', 'poseidon2'))
     t.witness_merkle_tree_cap(vk.setup_merkle_cap)
     t.witness_field_elements(proof.public_inputs)
     t.witness_merkle_tree_cap(proof.witness_cap)

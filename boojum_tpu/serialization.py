@@ -53,7 +53,16 @@ def vk_from_json(s: str) -> VerificationKey:
             if d.get("quotient_degree") is not None
             else None
         ),
+        transcript=_checked_transcript(d.get("transcript", "poseidon2")),
     )
+
+
+def _checked_transcript(kind: str) -> str:
+    from .transcript import TRANSCRIPTS
+
+    if kind not in TRANSCRIPTS:
+        raise ValueError(f"unknown transcript kind in vk: {kind!r}")
+    return kind
 
 
 # -- setup fast serialization ------------------------------------------------

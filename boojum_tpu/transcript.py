@@ -14,6 +14,12 @@ from .hashes.poseidon2 import poseidon2_permutation_host
 
 
 class Poseidon2Transcript:
+    """Algebraic sponge transcript over a width-12 permutation; subclasses
+    swap the permutation (the reference is generic over the round function
+    the same way, transcript.rs:48)."""
+
+    _PERMUTATION = staticmethod(poseidon2_permutation_host)
+
     def __init__(self):
         self.state = [0] * 12
         self.buffer = []
@@ -30,7 +36,7 @@ class Poseidon2Transcript:
         if not self.buffer:
             if self.available:
                 return self.available.pop(0)
-            self.state = poseidon2_permutation_host(self.state)
+            self.state = self._PERMUTATION(self.state)
             self.available = list(self.state[:8])
             return self.available.pop(0)
         # rescue-prime padding: trailing 1, then zeros to a multiple of rate
@@ -40,7 +46,7 @@ class Poseidon2Transcript:
             to_absorb.append(0)
         for i in range(0, len(to_absorb), 8):
             self.state[:8] = to_absorb[i : i + 8]
-            self.state = poseidon2_permutation_host(self.state)
+            self.state = self._PERMUTATION(self.state)
         self.available = list(self.state[:8])
         return self.available.pop(0)
 
@@ -115,8 +121,20 @@ class Keccak256Transcript(_ByteTranscript):
         return keccak256(data)
 
 
+from .hashes.poseidon import poseidon_permutation_host as _poseidon_perm
+
+
+class PoseidonTranscript(Poseidon2Transcript):
+    """Same sponge semantics over the LEGACY Poseidon permutation
+    (reference GoldilocksPoisedonTranscript, transcript.rs:48 with the
+    original round function)."""
+
+    _PERMUTATION = staticmethod(_poseidon_perm)
+
+
 TRANSCRIPTS = {
     "poseidon2": Poseidon2Transcript,
+    "poseidon": PoseidonTranscript,
     "blake2s": Blake2sTranscript,
     "keccak256": Keccak256Transcript,
 }
