@@ -149,6 +149,13 @@ class NativeTapeResolver(WitnessResolver):
     a thread pool.
     """
 
+    # tape batches at or above this launch on the worker thread DURING
+    # synthesis (the ctypes execute releases the GIL, so native resolution
+    # overlaps python gate placement — the TPU-side answer to the
+    # reference's synthesis-parallel ResolutionWindow workers,
+    # mt/resolution_window.rs:111)
+    ASYNC_THRESHOLD = 8192
+
     def __init__(self, lib, capacity: int = 1 << 16):
         super().__init__(capacity=capacity)
         from ..native import NativeTape
@@ -157,28 +164,68 @@ class NativeTapeResolver(WitnessResolver):
         self._pending: set[int] = set()
         self._max_place = -1
         self._poison: Exception | None = None
+        self._executor = None
+        self._inflight: list = []  # [(future, out_places_list)]
+        self._inflight_places: set[int] = set()
 
     def _available(self, place: int) -> bool:
         return (
-            place < len(self.resolved) and bool(self.resolved[place])
-        ) or place in self._pending
+            (place < len(self.resolved) and bool(self.resolved[place]))
+            or place in self._pending
+            or place in self._inflight_places
+        )
 
-    def flush(self):
-        if not len(self._tape):
+    def _ensure(self, idx: int):
+        # the worker writes into self.values in place: a reallocation while
+        # a batch is in flight would strand its writes in the old buffer
+        if idx >= len(self.values) and self._inflight:
+            self._join()
+        super()._ensure(idx)
+
+    def flush_async(self):
+        """Detach the current tape batch and execute it on the worker
+        thread; synthesis keeps running. Batches are FIFO on one worker, so
+        a later batch always sees the values an earlier one wrote."""
+        snap = self._tape.take_snapshot()
+        if snap is None:
             return
         self._ensure(self._max_place)
-        try:
-            out_places = self._tape.execute(self.values)
-        except Exception as e:
-            # the tape is consumed even on failure (partial execution; a
-            # rerun would double-bump lookup multiplicities), so the
-            # still-pending places can never materialize: poison the
-            # resolver so later reads surface THIS error instead of a
-            # misleading 'place unresolved' assert.
-            self._pending.clear()
-            self._poison = e
-            raise
-        self.resolved[np.array(out_places, dtype=np.int64)] = True
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="boojum-tape"
+            )
+        out_places = snap[7]
+        fut = self._executor.submit(
+            self._tape.run_snapshot, self.values, snap
+        )
+        self._inflight.append((fut, out_places))
+        self._inflight_places.update(out_places)
+        self._pending.difference_update(out_places)
+
+    def _join(self):
+        """Wait for every in-flight batch and publish its results."""
+        inflight, self._inflight = self._inflight, []
+        self._inflight_places.clear()
+        for fut, out_places in inflight:
+            try:
+                fut.result()
+            except Exception as e:
+                # a failed batch cannot be re-executed (partial execution
+                # would double-bump lookup multiplicities): poison the
+                # resolver so later reads surface THIS error instead of a
+                # misleading 'place unresolved' assert.
+                self._pending.clear()
+                self._poison = e
+                raise
+            self.resolved[np.array(out_places, dtype=np.int64)] = True
+
+    def flush(self):
+        if len(self._tape):
+            self.flush_async()
+        if self._inflight:
+            self._join()
         self._pending.clear()
         # fire python waiters parked on natively-resolved places
         if self._waiters:
@@ -213,12 +260,12 @@ class NativeTapeResolver(WitnessResolver):
         return super().values_flat(count)
 
     def is_resolved(self, place: int) -> bool:
-        if place in self._pending:
+        if place in self._pending or place in self._inflight_places:
             self.flush()
         return super().is_resolved(place)
 
     def get_value(self, place: int) -> int:
-        if place in self._pending:
+        if place in self._pending or place in self._inflight_places:
             self.flush()
         if self._poison is not None and not super().is_resolved(place):
             raise RuntimeError(
@@ -235,23 +282,36 @@ class NativeTapeResolver(WitnessResolver):
             self._log_execution(reg_id)
             kind, params = native
             if table is not None:
-                self._tape.ensure_table(int(params[0]), table)
-                params = (self._tape.slot_of(int(params[0])),)
+                tid = int(params[0])
+                if self._inflight and not self._tape.has_table(tid):
+                    # registering a table resizes the C engine's table
+                    # vector, which an in-flight execute_tape dereferences:
+                    # drain the worker before mutating engine state
+                    self._join()
+                self._tape.ensure_table(tid, table)
+                params = (self._tape.slot_of(tid),)
             self._tape.append(kind, params, ins, outs)
             if outs:
                 self._pending.update(outs)
                 m = max(outs)
                 if m > self._max_place:
                     self._max_place = m
+            if len(self._tape) >= self.ASYNC_THRESHOLD:
+                self.flush_async()
             return
         if native is not None:
             # inputs not all available natively: fall back to the closure
             # path, flushing first so tape-pending inputs materialize
-            if any(p in self._pending for p in ins):
+            if any(
+                p in self._pending or p in self._inflight_places
+                for p in ins
+            ):
                 self.flush()
         super().add_resolution(ins, outs, fn)
 
     def native_multiplicities(self, table_id: int):
+        # engine-side counters bump during execution: drain everything first
+        self.flush()
         return self._tape.multiplicities_of(table_id)
 
 

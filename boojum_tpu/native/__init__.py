@@ -139,6 +139,9 @@ class NativeTape:
         assert rc == 0
         self._slot_of[table_id] = slot
 
+    def has_table(self, table_id: int) -> bool:
+        return table_id in self._slot_of
+
     def slot_of(self, table_id: int) -> int:
         return self._slot_of[table_id]
 
@@ -148,31 +151,25 @@ class NativeTape:
             return None
         return self.multiplicities(slot)
 
-    def execute(self, values: np.ndarray) -> list:
-        """Run all pending ops against the arena; returns the out places."""
+    def take_snapshot(self):
+        """Detach the accumulated ops as dense arrays (the tape resets).
+
+        Returns None when empty, else an opaque snapshot consumed by
+        `run_snapshot` — the split lets a worker thread execute one batch
+        while synthesis keeps appending to the (fresh) tape."""
         if not self.kinds:
-            return []
-        kinds = np.array(self.kinds, dtype=np.int64)
-        params = np.array(self.params, dtype=np.uint64)
-        p_off = np.array(self.param_off, dtype=np.int64)
-        ins = np.array(self.ins, dtype=np.int64)
-        i_off = np.array(self.in_off, dtype=np.int64)
-        outs = np.array(self.outs, dtype=np.int64)
-        o_off = np.array(self.out_off, dtype=np.int64)
-        rc = self.lib.execute_tape(
-            _as_u64p(values), len(values),
-            _as_i64p(kinds), len(kinds),
-            _as_u64p(params), _as_i64p(p_off),
-            _as_i64p(ins), _as_i64p(i_off),
-            _as_i64p(outs), _as_i64p(o_off),
+            return None
+        snap = (
+            np.array(self.kinds, dtype=np.int64),
+            np.array(self.params, dtype=np.uint64),
+            np.array(self.param_off, dtype=np.int64),
+            np.array(self.ins, dtype=np.int64),
+            np.array(self.in_off, dtype=np.int64),
+            np.array(self.outs, dtype=np.int64),
+            np.array(self.out_off, dtype=np.int64),
+            self.outs,
+            self.kinds,
         )
-        # clear the tape BEFORE acting on the result: a failed batch must
-        # never be re-executed (ops before the failure already ran — a
-        # second pass would double-bump lookup multiplicities)
-        out_places = self.outs
-        failed_kind = None
-        if rc != 0:
-            failed_kind = self.kinds[-int(rc) - 1]
         self.kinds = []
         self.params = []
         self.param_off = [0]
@@ -180,12 +177,37 @@ class NativeTape:
         self.in_off = [0]
         self.outs = []
         self.out_off = [0]
+        return snap
+
+    def run_snapshot(self, values: np.ndarray, snap) -> list:
+        """Execute a snapshot against the arena; returns the out places.
+
+        The ctypes call releases the GIL, so running this on a worker
+        thread overlaps native resolution with python-side synthesis. A
+        failed batch must never be re-executed (ops before the failure
+        already ran — a second pass would double-bump lookup
+        multiplicities); snapshots are one-shot by construction."""
+        kinds, params, p_off, ins, i_off, outs, o_off, out_places, kl = snap
+        rc = self.lib.execute_tape(
+            _as_u64p(values), len(values),
+            _as_i64p(kinds), len(kinds),
+            _as_u64p(params), _as_i64p(p_off),
+            _as_i64p(ins), _as_i64p(i_off),
+            _as_i64p(outs), _as_i64p(o_off),
+        )
         if rc != 0:
             raise RuntimeError(
-                f"native resolver op (kind {failed_kind}) failed — "
+                f"native resolver op (kind {kl[-int(rc) - 1]}) failed — "
                 "lookup miss, oversized key, or unregistered table"
             )
         return out_places
+
+    def execute(self, values: np.ndarray) -> list:
+        """Run all pending ops against the arena; returns the out places."""
+        snap = self.take_snapshot()
+        if snap is None:
+            return []
+        return self.run_snapshot(values, snap)
 
     def multiplicities(self, table_id: int) -> np.ndarray:
         rows = ctypes.c_int64()

@@ -110,6 +110,15 @@ def shard_leaves(arr):
     return jax.device_put(arr, NamedSharding(m, spec))
 
 
+def default_col_axis(n: int) -> int:
+    """Favor the column axis (columns carry the zero-communication phase):
+    the largest power of two <= sqrt-ish of the device count dividing it."""
+    col_axis = 1 << (n.bit_length() // 2)
+    while n % col_axis:
+        col_axis //= 2
+    return col_axis
+
+
 def make_mesh(devices=None, col_axis: int | None = None) -> Mesh:
     """2D ('col', 'row') mesh over the given (or all) devices.
 
@@ -119,10 +128,7 @@ def make_mesh(devices=None, col_axis: int | None = None) -> Mesh:
         devices = jax.devices()
     n = len(devices)
     if col_axis is None:
-        # favor the column axis: columns carry the zero-communication phase
-        col_axis = 1 << ((n.bit_length() - 1 + 1) // 2)
-        while n % col_axis:
-            col_axis //= 2
+        col_axis = default_col_axis(n)
     row_axis = n // col_axis
     dev_grid = np.array(devices).reshape(col_axis, row_axis)
     return Mesh(dev_grid, axis_names=("col", "row"))
