@@ -305,6 +305,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # column phase (grand product + lookup polys) stays replicated
     copy_vals = shard_cols(copy_vals)
     wit_mono = monomial_from_values(witness_cols)
+    del witness_cols, cols  # values over H: monomials carry them from here
     wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
     wit_tree, _ = _commit_columns(wit_lde, cap)
     t.witness_merkle_tree_cap(wit_tree.get_cap())
@@ -321,6 +322,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         copy_vals, sigma_dev, setup.non_residues, beta, gamma,
         geometry.max_allowed_constraint_degree,
     )
+    del sigma_dev  # round 3 reads sigmas from the setup monomials
     stage2_list = [z[0], z[1]] + [c for p in partials for c in (p[0], p[1])]
     num_partials = len(partials)
     if lk_mode == "specialized":
@@ -360,7 +362,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             stage2_list += [a[0], a[1]]
         stage2_list += [b_poly[0], b_poly[1]]
     stage2_cols = shard_cols(jnp.stack(stage2_list))
+    del copy_vals, stage2_list  # round 2's H-domain inputs are done
     s2_mono = monomial_from_values(stage2_cols)
+    del stage2_cols
     s2_lde = lde_from_monomial(s2_mono, L)
     s2_tree, _ = _commit_columns(s2_lde, cap)
     t.witness_merkle_tree_cap(s2_tree.get_cap())
@@ -383,20 +387,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         distribute_powers(s2_mono[0], omega),
         distribute_powers(s2_mono[1], omega),
     )
-    S_cols = s2_mono.shape[0]
-    sweep_mono = jnp.concatenate(
-        [
-            wit_mono,
-            setup.setup_monomials,
-            s2_mono,
-            z_shift_mono[0][None, :],
-            z_shift_mono[1][None, :],
-        ],
-        axis=0,
-    )
-    off_setup = Ct + W + M
-    off_s2 = off_setup + Ct + K + TW
-    off_zs = off_s2 + S_cols
+    # per-coset evaluation happens per GROUP (witness / setup / stage-2 /
+    # shifted-z) straight from the existing monomial stacks — concatenating
+    # them would duplicate every committed polynomial's monomials (~1.5 GB
+    # at 2^20 rows) purely for indexing convenience
+    zs_mono = jnp.stack([z_shift_mono[0], z_shift_mono[1]])
 
     xs_q = _domain_xs_brev(log_n, Q)
     l0_q = _l0_brev(log_n, Q)
@@ -418,17 +413,18 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     T_parts0, T_parts1 = [], []
     for c in range(Q):
-        vals = _coset_eval(sweep_mono, scale_q[c])  # (B_stack, n)
-        wit_v = vals[:off_setup]
+        row = scale_q[c]
+        wit_v = _coset_eval(wit_mono, row)
+        setup_v = _coset_eval(setup.setup_monomials, row)
+        s2_v = _coset_eval(s2_mono, row)
+        zs_v = _coset_eval(zs_mono, row)
         copy_v = wit_v[:Ct]
         gate_wit_v = wit_v[Ct : Ct + W] if W else None
-        setup_v = vals[off_setup:off_s2]
         sigma_v = setup_v[:Ct]
         const_v = setup_v[Ct : Ct + K]
         table_v = setup_v[Ct + K :]
-        s2_v = vals[off_s2:off_zs]
         z_v = (s2_v[0], s2_v[1])
-        z_shift_v = (vals[off_zs], vals[off_zs + 1])
+        z_shift_v = (zs_v[0], zs_v[1])
         partial_v = [
             (s2_v[2 + 2 * j], s2_v[3 + 2 * j]) for j in range(num_partials)
         ]
