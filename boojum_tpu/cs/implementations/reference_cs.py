@@ -479,6 +479,18 @@ class CSAssembly:
     def __init__(self, **kw):
         self.__dict__.update(kw)
 
+    def __getstate__(self):
+        # picklable snapshot (long synthesis runs checkpoint the frozen
+        # assembly): the resolver holds ctypes handles into the native
+        # engine and is only needed for post-freeze witness hooks — the
+        # materialized columns/multiplicities below carry the proof inputs
+        state = dict(self.__dict__)
+        state["resolver"] = None
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
     @property
     def num_copy_cols(self):
         """General-purpose copy columns (gates live here)."""
@@ -537,6 +549,13 @@ class CSAssembly:
         """Flat resolver value arena for every allocated place (reference
         `WitnessVec`, witness.rs:32): the portable witness artifact for
         repeated proving."""
+        if self.resolver is None:
+            raise RuntimeError(
+                "witness_vec() needs the live resolver, which pickled "
+                "assembly checkpoints drop — call it before pickling and "
+                "carry the vector alongside, or rebuild via "
+                "with_external_witness"
+            )
         num_places = int(
             max(
                 self.copy_placement.max(initial=-1),

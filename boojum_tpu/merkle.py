@@ -40,8 +40,9 @@ def _tree_tail_layers(digests, cap_size: int):
     return tuple(layers)
 
 
-def _tree_layers(leaf_values, cap_size: int):
-    digests = leaf_hash(leaf_values)
+def _node_layers(digests, cap_size: int):
+    """Digest layers from leaf digests up to the cap (shared by the
+    materialized and streamed-commit paths)."""
     layers = [digests]
     while (
         layers[-1].shape[0] > cap_size
@@ -52,6 +53,10 @@ def _tree_layers(leaf_values, cap_size: int):
     if layers[-1].shape[0] > cap_size:
         layers.extend(_tree_tail_layers(layers[-1], cap_size))
     return tuple(layers)
+
+
+def _tree_layers(leaf_values, cap_size: int):
+    return _node_layers(leaf_hash(leaf_values), cap_size)
 
 
 class MerkleTreeWithCap:
@@ -76,6 +81,25 @@ class MerkleTreeWithCap:
         self._cap_host = [
             tuple(int(x) for x in row) for row in np.asarray(self.layers[-1])
         ]
+
+    @classmethod
+    def from_digests(cls, digests, cap_size: int) -> "MerkleTreeWithCap":
+        """Build the node layers over precomputed (num_leaves, 4) leaf
+        digests — the streamed-commit path hashes leaves in column blocks
+        (absorbing 8 columns at a time into a carried sponge state) and
+        hands the finished digests here, so a full (num_leaves, width)
+        leaf matrix never materializes."""
+        tree = cls.__new__(cls)
+        n = int(digests.shape[0])
+        assert n & (n - 1) == 0, "leaf count must be 2^k"
+        assert cap_size & (cap_size - 1) == 0 and n >= cap_size
+        tree.cap_size = cap_size
+        tree.num_leaves = n
+        tree.layers = list(_node_layers(digests, cap_size))
+        tree._cap_host = [
+            tuple(int(x) for x in row) for row in np.asarray(tree.layers[-1])
+        ]
+        return tree
 
     @classmethod
     def from_layers(cls, layers, cap_size: int) -> "MerkleTreeWithCap":

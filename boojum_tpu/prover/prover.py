@@ -61,6 +61,19 @@ class _StageClock:
 
     def start(self, name):
         self.stop()
+        import os
+
+        if os.environ.get("BOOJUM_TPU_MEMLOG"):
+            import sys
+
+            live = jax.live_arrays()
+            total = sum(a.size * a.dtype.itemsize for a in live)
+            print(
+                f"[boojum_tpu mem] before {name}: {total / 2**30:.2f} GiB "
+                f"({len(live)} arrays)",
+                file=sys.stderr,
+                flush=True,
+            )
         self._cm = stage_timer(name)
         self._cm.__enter__()
 
@@ -68,8 +81,15 @@ class _StageClock:
         if self._cm is not None:
             self._cm.__exit__(None, None, None)
             self._cm = None
+from .streaming import (
+    MonomialSource,
+    commit_streaming,
+    deep_source_blocks,
+    use_streamed_lde,
+)
 from .stages import (
     AlphaPows,
+    chunk_columns,
     compute_copy_permutation_stage2,
     compute_lookup_polys,
     copy_permutation_quotient_terms,
@@ -107,28 +127,20 @@ _DEEP_BLOCK_BUDGET = 128 << 20  # bytes of columns per contraction block
 def _deep_main_sum(lde_sources, y0s, y1s, c0s, c1s, inv_xz):
     """Σ_i ch_i·(f_i − y_i)/(x − z) over all opened columns.
 
-    `lde_sources` is a list of (B_k, N) arrays consumed in order (witness,
-    setup, stage-2, quotient) — iterating them directly avoids materializing
-    their multi-GB concatenation. One batched contraction per column BLOCK:
-    Σ ch_i·f_i is two base-field log-tree reductions (fully parallel on the
-    VPU; the sequential lax.scan this replaced serialized B device steps and
-    dominated round 5), and the blocks bound the transient (columns x
-    domain) product that OOM'd 2^20-row traces when materialized whole."""
-    N = lde_sources[0].shape[-1]
-    per = max(1, _DEEP_BLOCK_BUDGET // (N * 8))
+    `lde_sources` mixes (B_k, N) arrays and MonomialSource oracles consumed
+    in order (witness, setup, stage-2, quotient) — iterating blocks avoids
+    materializing the multi-GB concatenation, and MonomialSource blocks
+    regenerate streamed oracles from monomials on the fly. One batched
+    contraction per column BLOCK: Σ ch_i·f_i is two base-field log-tree
+    reductions (fully parallel on the VPU; the sequential lax.scan this
+    replaced serialized B device steps and dominated round 5)."""
     t0 = None
     t1 = None
-    off = 0
-    for src in lde_sources:
-        B = src.shape[0]
-        for i in range(0, B, per):
-            j = min(i + per, B)
-            b0, b1 = _deep_block(
-                src[i:j], c0s[off + i : off + j], c1s[off + i : off + j]
-            )
-            t0 = b0 if t0 is None else gf.add(t0, b0)
-            t1 = b1 if t1 is None else gf.add(t1, b1)
-        off += B
+    for blk, off in deep_source_blocks(lde_sources, _DEEP_BLOCK_BUDGET):
+        j = off + blk.shape[0]
+        b0, b1 = _deep_block(blk, c0s[off:j], c1s[off:j])
+        t0 = b0 if t0 is None else gf.add(t0, b0)
+        t1 = b1 if t1 is None else gf.add(t1, b1)
     return _deep_combine(t0, t1, y0s, y1s, c0s, c1s, inv_xz)
 
 
@@ -306,8 +318,25 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     copy_vals = shard_cols(copy_vals)
     wit_mono = monomial_from_values(witness_cols)
     del witness_cols, cols  # values over H: monomials carry them from here
-    wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
-    wit_tree, _ = _commit_columns(wit_lde, cap)
+    # streamed commit-rate mode: above the footprint threshold the rate-L
+    # storages are never materialized — commits absorb column blocks into a
+    # carried sponge state, DEEP/queries regenerate blocks from monomials
+    # (see prover/streaming.py). Mesh runs keep the materialized path (its
+    # sharding constraints pool HBM across chips).
+    from ..parallel.sharding import active_mesh
+
+    num_chunks_est = len(
+        chunk_columns(Ct, geometry.max_allowed_constraint_degree)
+    )
+    S_est = 2 * num_chunks_est + 2 * R_args + 2 * M
+    Q_est = setup.vk.effective_quotient_degree()
+    total_cols = (Ct + W + M) + (Ct + K + TW) + S_est + 2 * Q_est
+    stream = active_mesh() is None and use_streamed_lde(total_cols, N)
+    if stream:
+        wit_tree = commit_streaming(wit_mono, L, cap)
+    else:
+        wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
+        wit_tree, _ = _commit_columns(wit_lde, cap)
     t.witness_merkle_tree_cap(wit_tree.get_cap())
     beta = t.get_ext_challenge()
     gamma = t.get_ext_challenge()
@@ -365,8 +394,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     del copy_vals, stage2_list  # round 2's H-domain inputs are done
     s2_mono = monomial_from_values(stage2_cols)
     del stage2_cols
-    s2_lde = lde_from_monomial(s2_mono, L)
-    s2_tree, _ = _commit_columns(s2_lde, cap)
+    if stream:
+        s2_tree = commit_streaming(s2_mono, L, cap)
+    else:
+        s2_lde = lde_from_monomial(s2_mono, L)
+        s2_tree, _ = _commit_columns(s2_lde, cap)
     t.witness_merkle_tree_cap(s2_tree.get_cap())
     alpha = t.get_ext_challenge()
 
@@ -378,9 +410,20 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # which is what lets 2^20-row traces prove at the Era commit rate L=2.
     clock.start("round3_quotient")
     Q = setup.vk.effective_quotient_degree()
-    wit_lde_all = wit_lde.reshape(Ct + W + M, N)
-    setup_lde_flat = shard_cols(setup.setup_lde.reshape(Ct + K + TW, N))
-    s2_lde_flat = s2_lde.reshape(-1, N)
+    if stream:
+        wit_lde_all = MonomialSource(wit_mono, L)
+        s2_lde_flat = MonomialSource(s2_mono, L)
+    else:
+        wit_lde_all = wit_lde.reshape(Ct + W + M, N)
+        s2_lde_flat = s2_lde.reshape(-1, N)
+    # the setup oracle follows HOW IT WAS COMMITTED: a materialized
+    # setup_lde is already resident (and shardable under a mesh) — never
+    # regenerate it; only a streamed-committed setup (setup_lde None)
+    # streams here too
+    if setup.setup_lde is None:
+        setup_lde_flat = MonomialSource(setup.setup_monomials, L)
+    else:
+        setup_lde_flat = shard_cols(setup.setup_lde.reshape(Ct + K + TW, N))
     xs_lde = _domain_xs_brev(log_n, L)
     omega = gl.omega(log_n)
     z_shift_mono = (
@@ -469,13 +512,19 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             acc = ext_f.add(acc, lk_acc)
         T_parts0.append(gf.mul(acc[0], zh_inv_q[sl]))
         T_parts1.append(gf.mul(acc[1], zh_inv_q[sl]))
+    # the last coset's group evaluations (~2 GB at 2^20) are dead here;
+    # free them before the N_Q-size interpolation allocates its stages
+    del wit_v, setup_v, s2_v, zs_v, copy_v, gate_wit_v, sigma_v, const_v
+    del table_v, z_v, z_shift_v, partial_v, acc, cp_acc
     T = (jnp.concatenate(T_parts0), jnp.concatenate(T_parts1))
+    del T_parts0, T_parts1
     # interpolate over the full rate-Q domain to monomial form
     g_inv = gl.inv(gl.MULTIPLICATIVE_GENERATOR)
     T_mono = tuple(
         distribute_powers(ifft_bitreversed_to_natural(T[i]), g_inv)
         for i in (0, 1)
     )
+    del T
     # split into Q chunks of degree < n, interleave (c0, c1); COMMIT at L
     q_cols = []
     for i in range(Q):
@@ -523,6 +572,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
     clock.start("round5_deep_fri")
+
+    def _col(src, i):
+        return src.column(i) if isinstance(src, MonomialSource) else src[i]
+
     deep_sources = [
         wit_lde_all,
         setup_lde_flat,
@@ -557,7 +610,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         ch = (c0[0], c1[0])
         y = values_at_z_omega[i]
         num = (
-            gf.sub(s2_lde_flat[i], jnp.uint64(y[0])),
+            gf.sub(_col(s2_lde_flat, i), jnp.uint64(y[0])),
             jnp.broadcast_to(jnp.uint64(gl.neg(y[1])), xs_lde.shape),
         )
         term = ext_f.mul(ext_f.mul(num, inv_xzw), ch)
@@ -571,8 +624,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             ch = (c0[0], c1[0])
             v0, v1 = values_at_0[i]
             num = (
-                gf.sub(s2_lde_flat[ab_off + 2 * i], jnp.uint64(v0)),
-                gf.sub(s2_lde_flat[ab_off + 2 * i + 1], jnp.uint64(v1)),
+                gf.sub(_col(s2_lde_flat, ab_off + 2 * i), jnp.uint64(v0)),
+                gf.sub(_col(s2_lde_flat, ab_off + 2 * i + 1), jnp.uint64(v1)),
             )
             term = ext_f.mul((gf.mul(num[0], inv_x), gf.mul(num[1], inv_x)), ch)
             h = ext_f.add(h, term)
@@ -585,7 +638,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         for k, (col, _row, value) in enumerate(assembly.public_inputs):
             c0, c1 = deep_pows.take(1)
             ch = (c0[0], c1[0])
-            num = gf.sub(wit_lde_all[col], jnp.uint64(value))
+            num = gf.sub(_col(wit_lde_all, col), jnp.uint64(value))
             term_base = gf.mul(num, denoms[k])
             h = ext_f.add(h, (gf.mul(term_base, ch[0]), gf.mul(term_base, ch[1])))
 
@@ -613,7 +666,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         return len(fetch_parts) - 1, arr.shape
 
     def _defer_oracle(leaves_cols, tree):
-        vals_h = _defer(leaves_cols[:, idx_dev])  # (B, Q) lazy
+        if isinstance(leaves_cols, MonomialSource):
+            vals = leaves_cols.gather_rows(idx_dev)  # (B, Q) lazy blocks
+        else:
+            vals = leaves_cols[:, idx_dev]
+        vals_h = _defer(vals)
         pending, assemble = tree.proof_gathers(idxs)
         level_hs = [_defer(p) for p in pending]
         return vals_h, level_hs, assemble

@@ -99,11 +99,38 @@ def compute_sigma_values(copy_placement: np.ndarray, trace_len: int):
     ks = np.array(non_residues_for_copy_permutation(C), dtype=np.uint64)
     tgt_col = (sigma_cell // n).astype(np.int64)
     tgt_row = (sigma_cell % n).astype(np.int64)
-    # modmul on host via python objects is slow; use 128-bit numpy trick:
-    a = ks[tgt_col].astype(object)
-    b = w_pows[tgt_row].astype(object)
-    vals = (a * b) % gl.P
-    return np.array(vals, dtype=np.uint64).reshape(C, n)
+    vals = _np_mod_mul(ks[tgt_col], w_pows[tgt_row])
+    return vals.reshape(C, n)
+
+
+def _np_mod_mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Vectorized Goldilocks multiply on host uint64 arrays (the same
+    EPSILON-reduction as field/goldilocks.py, in numpy — the python-object
+    bigint path this replaces cost ~20 minutes for a 92x2^20 sigma)."""
+    M32 = np.uint64(0xFFFFFFFF)
+    a_lo = a & M32
+    a_hi = a >> np.uint64(32)
+    b_lo = b & M32
+    b_hi = b >> np.uint64(32)
+    with np.errstate(over="ignore"):
+        ll = a_lo * b_lo
+        lh = a_lo * b_hi
+        hl = a_hi * b_lo
+        hh = a_hi * b_hi
+        mid = lh + hl
+        mid_c = (mid < lh).astype(np.uint64)
+        lo = ll + (mid << np.uint64(32))
+        lo_c = (lo < ll).astype(np.uint64)
+        hi = hh + (mid >> np.uint64(32)) + (mid_c << np.uint64(32)) + lo_c
+        # reduce128: x = lo - hi_hi + hi_lo * EPSILON
+        hi_hi = hi >> np.uint64(32)
+        hi_lo = hi & M32
+        t0 = lo - hi_hi
+        t0 = np.where(lo < hi_hi, t0 - M32, t0)
+        t1 = hi_lo * M32
+        t2 = t0 + t1
+        res = np.where(t2 < t0, t2 + M32, t2)
+        return np.where(res >= np.uint64(gl.P), res - np.uint64(gl.P), res)
 
 
 def build_constant_columns(assembly, selector_paths) -> np.ndarray:
@@ -217,7 +244,7 @@ class SetupData:
     sigma_cols: np.ndarray  # (C, n) host
     constant_cols: np.ndarray  # (K, n) host
     setup_monomials: object  # (C+K, n) device
-    setup_lde: object  # (C+K, lde, n) device
+    setup_lde: object  # (C+K, lde, n) device, or None in streamed mode
     setup_tree: MerkleTreeWithCap
     selector_paths: list
     non_residues: list
@@ -277,9 +304,21 @@ def generate_setup(assembly, config) -> SetupData:
         setup_cols = np.concatenate([sigma, consts], axis=0)
     dev = jnp.asarray(setup_cols)
     monomials = monomial_from_values(dev)
-    lde = lde_from_monomial(monomials, config.fri_lde_factor)
-    leaves = lde.reshape(lde.shape[0], -1).T  # (lde*n, C+K)
-    tree = MerkleTreeWithCap(leaves, config.merkle_tree_cap_size)
+    del dev
+    from .streaming import commit_streaming, use_streamed_lde
+
+    if use_streamed_lde(setup_cols.shape[0], n * config.fri_lde_factor):
+        # beyond the footprint threshold the setup LDE is never
+        # materialized: the tree commits from streamed column blocks and
+        # the prover regenerates blocks from the monomials (streaming.py)
+        lde = None
+        tree = commit_streaming(
+            monomials, config.fri_lde_factor, config.merkle_tree_cap_size
+        )
+    else:
+        lde = lde_from_monomial(monomials, config.fri_lde_factor)
+        leaves = lde.reshape(lde.shape[0], -1).T  # (lde*n, C+K)
+        tree = MerkleTreeWithCap(leaves, config.merkle_tree_cap_size)
     vk = VerificationKey(
         geometry=assembly.geometry,
         trace_len=n,

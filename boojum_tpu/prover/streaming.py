@@ -1,0 +1,138 @@
+"""Streamed commit-rate LDE: bound HBM by never materializing full LDE
+storages.
+
+The reference's long-trace posture is cache-friendly blocked processing
+(SURVEY §5); on an accelerator the binding constraint is HBM: at 2^20 rows
+the materialized rate-L storages (witness + setup + stage-2 + quotient)
+exceed the chip even at the Era commit rate. This module streams them in
+column blocks straight from the (always-resident) monomials:
+
+- commit: blocks of <= 64 columns LDE-transform, transpose to rows, and
+  absorb 8 columns at a time into a CARRIED sponge state (N, 12) — the
+  digest stream feeds `MerkleTreeWithCap.from_digests`, so the full
+  (N, total_cols) leaf matrix never exists. Absorption order equals
+  `leaf_hash` over whole rows, so trees (and proofs) are BIT-IDENTICAL to
+  the materialized path.
+- DEEP / query gathers: the same block generator re-evaluates each column
+  block at query time (one extra LDE pass each — FLOPs traded for the
+  ~4 GB of residency the materialized path pins).
+
+Streaming engages when the committed-storage footprint would exceed
+BOOJUM_TPU_STREAM_LDE bytes (default 1.5 GiB; "1" forces on, "0" off) —
+small traces keep the materialized fast path.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ..merkle import MerkleTreeWithCap
+from ..ntt import lde_from_monomial
+
+# columns per streamed block (a multiple of the sponge rate 8)
+COL_BLOCK = 32
+
+
+def stream_threshold_bytes() -> float:
+    v = os.environ.get("BOOJUM_TPU_STREAM_LDE", "").strip()
+    if v == "0":
+        return float("inf")
+    if v == "1":
+        return 0.0
+    if v:
+        try:
+            return float(v)  # explicit byte threshold
+        except ValueError:
+            pass
+    return float(1536 << 20)
+
+
+def use_streamed_lde(total_cols: int, domain_size: int) -> bool:
+    return total_cols * domain_size * 8 > stream_threshold_bytes()
+
+
+class MonomialSource:
+    """A committed oracle's columns, represented by monomials + rate.
+
+    Stands in for the materialized (B, L*n) flat array in the DEEP and
+    query phases; `blocks()` regenerates rate-L column blocks on demand."""
+
+    def __init__(self, mono, L: int):
+        self.mono = mono
+        self.L = int(L)
+
+    @property
+    def shape(self):
+        return (self.mono.shape[0], self.mono.shape[-1] * self.L)
+
+    def blocks(self, per: int = COL_BLOCK):
+        B = self.mono.shape[0]
+        for i in range(0, B, per):
+            lde = lde_from_monomial(self.mono[i : i + per], self.L)
+            yield i, lde.reshape(lde.shape[0], -1)  # (b, N)
+
+    def column(self, i: int):
+        """One column's rate-L values (N,) — for the handful of single
+        columns round 5 opens at shifted points."""
+        lde = lde_from_monomial(self.mono[i : i + 1], self.L)
+        return lde.reshape(-1)
+
+    def gather_rows(self, idx_dev):
+        """(B, num_queries) leaf-value gather, blockwise."""
+        parts = [flat[:, idx_dev] for _, flat in self.blocks()]
+        return jnp.concatenate(parts, axis=0)
+
+
+@jax.jit
+def _sponge_absorb8(state, chunk8):
+    """Overwrite-absorb 8 columns into a carried (N, 12) sponge state."""
+    from ..hashes.poseidon2 import poseidon2_permutation
+
+    st = jnp.concatenate([chunk8, state[:, 8:]], axis=-1)
+    return poseidon2_permutation(st)
+
+
+def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:
+    """Merkle-commit the rate-L LDE of `mono` without materializing it.
+
+    Bit-identical to MerkleTreeWithCap(leaf_hash semantics) over the
+    (N, B) leaf matrix: full 8-column chunks absorb in order, the trailing
+    partial chunk zero-pads (the sponge finalize rule)."""
+    n = mono.shape[-1]
+    N = n * L
+    state = jnp.zeros((N, 12), jnp.uint64)
+    rem = None  # (N, r < 8) trailing columns
+    for _, flat in MonomialSource(mono, L).blocks():
+        cols = flat.T  # (N, b)
+        if rem is not None:
+            cols = jnp.concatenate([rem, cols], axis=1)
+            rem = None
+        b = cols.shape[1]
+        for k in range(b // 8):
+            state = _sponge_absorb8(state, cols[:, 8 * k : 8 * k + 8])
+        if b % 8:
+            rem = cols[:, (b // 8) * 8 :]
+    if rem is not None:
+        pad = jnp.zeros((N, 8 - rem.shape[1]), jnp.uint64)
+        state = _sponge_absorb8(state, jnp.concatenate([rem, pad], axis=1))
+    return MerkleTreeWithCap.from_digests(state[:, :4], cap_size)
+
+
+def deep_source_blocks(sources, per_bytes: int):
+    """Yield (block (b, N), column_offset) across mixed sources: plain
+    (B, N) arrays slice by a byte budget; MonomialSource regenerates."""
+    off = 0
+    for src in sources:
+        if isinstance(src, MonomialSource):
+            for i, flat in src.blocks():
+                yield flat, off + i
+            off += src.shape[0]
+        else:
+            B, N = src.shape
+            per = max(1, per_bytes // (N * 8))
+            for i in range(0, B, per):
+                yield src[i : i + per], off + i
+            off += B

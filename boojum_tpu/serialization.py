@@ -74,7 +74,12 @@ def save_setup(path: str, setup: SetupData):
         "sigma_cols": np.asarray(setup.sigma_cols),
         "constant_cols": np.asarray(setup.constant_cols),
         "setup_monomials": np.asarray(setup.setup_monomials),
-        "setup_lde": np.asarray(setup.setup_lde),
+        # streamed-mode setups carry no materialized LDE (rebuilt lazily)
+        **(
+            {"setup_lde": np.asarray(setup.setup_lde)}
+            if setup.setup_lde is not None
+            else {}
+        ),
         "non_residues": np.asarray(setup.non_residues, dtype=np.uint64),
         "vk_json": np.frombuffer(
             vk_to_json(setup.vk).encode(), dtype=np.uint8
@@ -105,7 +110,9 @@ def load_setup(path: str) -> SetupData:
             sigma_cols=z["sigma_cols"],
             constant_cols=z["constant_cols"],
             setup_monomials=jnp.asarray(z["setup_monomials"]),
-            setup_lde=jnp.asarray(z["setup_lde"]),
+            setup_lde=(
+                jnp.asarray(z["setup_lde"]) if "setup_lde" in z else None
+            ),
             setup_tree=tree,
             selector_paths=vk.selector_paths,
             non_residues=[int(v) for v in z["non_residues"]],
