@@ -1,0 +1,107 @@
+"""Kernel microbenchmarks, one JSON line per metric.
+
+Counterpart of the reference's criterion benches + profiling binary
+(`/root/reference/benches/benchmarks.rs:20`,
+`/root/reference/profiling-target/src/main.rs:17`): field mul, NTT across
+sizes, Poseidon2 permutation, batch inversion — so per-round kernel work is
+tracked by the record instead of ad-hoc session numbers.
+
+All metrics chain reps ON DEVICE inside one dispatch (jax.lax.fori_loop):
+behind the axon network tunnel every executable launch costs a ~10 ms round
+trip, which would otherwise measure the tunnel, not the chip.
+
+Usage: python bench_micro.py  (JSON lines on stdout; backend = ambient JAX)
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from boojum_tpu.field import gl
+from boojum_tpu.field import goldilocks as gf
+
+
+def _rand(shape, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, gl.P, size=shape, dtype=np.uint64))
+
+
+def timed_chain(body, x, reps):
+    @jax.jit
+    def run(v):
+        return jax.lax.fori_loop(0, reps, lambda _, u: body(u), v)
+
+    jax.block_until_ready(run(x))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(x))
+    return (time.perf_counter() - t0) / reps
+
+
+def emit(metric, value, unit, **extra):
+    print(json.dumps({"metric": metric, "value": value, "unit": unit, **extra}))
+
+
+def main():
+    backend = jax.default_backend()
+
+    # field mul throughput (a <- a*a + c keeps the chain live)
+    n = 1 << 22
+    a = _rand((n,), 1)
+    c = _rand((n,), 2)
+    dt = timed_chain(lambda v: gf.add(gf.mul(v, v), c), a, 8)
+    emit("field_mul_elems_per_s", int(n / dt), "elems/s", backend=backend)
+
+    # NTT fwd+inv pairs across sizes (64 columns at bench scale)
+    from boojum_tpu.ntt import (
+        fft_natural_to_bitreversed,
+        ifft_bitreversed_to_natural,
+    )
+
+    for log_n in (12, 14, 16, 18, 20):
+        cols = max(1, (1 << 22) >> log_n)
+        x = _rand((cols, 1 << log_n), 3 + log_n)
+        reps = 4 if log_n >= 18 else 8
+        dt = timed_chain(
+            lambda v: ifft_bitreversed_to_natural(
+                fft_natural_to_bitreversed(v)
+            ),
+            x,
+            reps,
+        )
+        emit(
+            f"ntt_2^{log_n}_pair_elems_per_s",
+            int(2 * cols * (1 << log_n) / dt),
+            "elems/s",
+            cols=cols,
+            backend=backend,
+        )
+
+    # Poseidon2 permutation
+    from boojum_tpu.hashes.poseidon2 import poseidon2_permutation
+
+    st = _rand((1 << 18, 12), 40)
+    dt = timed_chain(poseidon2_permutation, st, 4)
+    emit(
+        "poseidon2_perms_per_s", int((1 << 18) / dt), "perms/s",
+        backend=backend,
+    )
+
+    # batch inversion
+    b = _rand((1 << 20,), 50)
+    b = jnp.where(b == 0, jnp.uint64(1), b)
+    dt = timed_chain(gf.batch_inverse_xla, b, 4)
+    emit(
+        "batch_inverse_elems_per_s", int((1 << 20) / dt), "elems/s",
+        backend=backend,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -658,6 +658,11 @@ class CSAssembly:
             for (c, r, _v) in self.public_inputs
         ]
         new._gate_sweep_jit = None
+        # CSAssembly(**self.__dict__) SHARES mutable attrs with self — the
+        # prover's device-upload cache (witness columns, multiplicities)
+        # must not leak to an assembly with different witness values, or
+        # re-proving commits the OLD witness
+        new._dev_cache = {}
         return new
 
     def stacked_table_columns(self, width: int) -> np.ndarray:

@@ -170,21 +170,10 @@ def prefix_product(a: jax.Array) -> jax.Array:
 
 
 def batch_inverse(a: jax.Array) -> jax.Array:
-    """Montgomery batch inversion along the last axis.
-
-    Dispatches to the fused two-pass Pallas block-scan kernel on TPU
-    (field/pallas_scan.py — bit-identical results); the log-doubling XLA
-    form below is the generic path. Opt-in (BOOJUM_TPU_PALLAS_SCAN=1): the
-    (64,128)-tile sequential grid measured ~10x slower than the XLA scans
-    on v5e (carry serialization defeats pipelining) — kept for the kernel
-    parity surface until the tile scheme is reworked."""
-    from ..utils.pallas_util import pallas_enabled
-
-    if pallas_enabled("BOOJUM_TPU_PALLAS_SCAN"):
-        from . import pallas_scan
-
-        if pallas_scan.size_fits(a.shape[-1]):
-            return pallas_scan.batch_inverse(a)
+    """Montgomery batch inversion along the last axis (log-doubling XLA
+    scans; a sequential-tile Pallas block-scan was tried and measured ~10x
+    slower on v5e — carry serialization defeats pipelining — so the XLA
+    form is the single implementation)."""
     return batch_inverse_xla(a)
 
 

@@ -117,15 +117,15 @@ class MerkleTreeWithCap:
     def get_cap(self):
         return list(self._cap_host)
 
-    def proof_gathers(self, leaf_indices):
-        """Dispatch the per-level sibling gathers WITHOUT transferring:
-        returns (lazy device arrays, assemble(levels) -> paths). Lets the
-        prover fuse every oracle's query data into one host transfer."""
+    def proof_gather_plans(self, leaf_indices):
+        """Like proof_gathers, but returns (layer, sibling-index) PLANS
+        without dispatching any device op — the prover executes every
+        oracle's plans in one fused gather (see prover._gather_flat_fused)."""
         idxs = np.array(list(leaf_indices), dtype=np.int64)
-        pending = []
+        plans = []
         cur = idxs
         for layer in self.layers[:-1]:
-            pending.append(layer[jnp.asarray(cur ^ 1)])  # (Q, 4) lazy
+            plans.append((layer, cur ^ 1))
             cur = cur >> 1
 
         def assemble(levels):
@@ -134,6 +134,13 @@ class MerkleTreeWithCap:
                 for q in range(len(idxs))
             ]
 
+        return plans, assemble
+
+    def proof_gathers(self, leaf_indices):
+        """Dispatch the per-level sibling gathers WITHOUT transferring:
+        returns (lazy device arrays, assemble(levels) -> paths)."""
+        plans, assemble = self.proof_gather_plans(leaf_indices)
+        pending = [layer[jnp.asarray(ix)] for layer, ix in plans]
         return pending, assemble
 
     def get_proofs(self, leaf_indices):

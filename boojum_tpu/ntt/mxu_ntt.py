@@ -1,0 +1,506 @@
+"""NTT on the MXU: four-step decomposition as exact bf16 limb matmuls.
+
+TPU-native counterpart of the reference's vectorized NTT tier
+(`/root/reference/src/fft/mod.rs:852,1088` + the AVX-512/NEON MixedGL
+butterflies in `src/field/goldilocks/avx512_impl.rs`): where those beat the
+generic scalar path with hand-packed SIMD, this beats XLA's emulated-u64
+butterflies by moving the multiply work onto the systolic array.
+
+A size-n transform (n = R*C, R,C <= 256) is two matrix products against
+CONSTANT DFT matrices plus one elementwise twiddle:
+
+  forward  (natural -> bit-reversed):  out = ((D_R @ X) * T) @ D_C^T
+  inverse  (bit-reversed -> natural):  out = F @ ((X * 1) @ E_inv * T_inv)
+
+with
+  X      = the column viewed as an (R, C) matrix, x[i] at X[i // C][i % C]
+  D_R    = omega_R^(brev(a) * r)            (R x R)
+  T      = omega_n^(c * brev(a))            (R x C)
+  D_C    = omega_C^(brev(d) * c)            (C x C)
+  E_inv  = omega_C^(-brev(c) * c')          (C x C)
+  T_inv  = omega_n^(-c' * brev(r))          (R x C)
+  F      = n^-1 * omega_R^(-r' * brev(r))   (R x R)
+
+Both conventions come out so the row-major flattening of the result IS the
+bit-reversed (resp. natural) order — no transposes anywhere.
+
+Exact integer matmul on the MXU: every Goldilocks operand splits into eight
+8-bit limbs. Limbs (<= 255) are exactly representable in bfloat16, and a
+256-term dot of 8-bit limb products stays under 2^24, so the MXU's native
+bf16 x bf16 -> f32 accumulation is EXACT. The 64 per-(limb,limb) products are
+accumulated into 15 diagonal planes in int32 on the VPU, then folded mod p
+with 2^64 = eps = 2^32 - 1, 2^96 = -1, 2^128 = -2^32 (mod p).
+
+Sizes 2^14..2^16 run as single fused kernels; 2^17..2^22 run the leading
+(resp. trailing) radix-2 stages in XLA and drop bit-exactly into per-block
+2^16 kernels (DIF stage s only combines elements 2^16 apart for s < log_n-16,
+so the remaining per-block work is a plain 2^16 transform).
+
+Outputs are bit-identical to the staged-XLA path (`ntt.py`): same twiddle
+constants, exact integer arithmetic, canonical representatives.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..field import gl
+from ..field import limbs
+from ..utils.pallas_util import imap32
+
+MIN_LOG_N = 14  # below this C < 128 lanes and the XLA path is already cheap
+MAX_LOG_N = 16  # single-kernel ceiling; larger sizes go hybrid
+MAX_HYBRID_LOG_N = 22
+
+_u32 = jnp.uint32
+_MASK8 = np.uint32(0xFF)
+_P_LO = np.uint32(1)
+_P_HI = np.uint32(0xFFFFFFFF)
+_FULL = np.uint32(0xFFFFFFFF)
+
+_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+
+
+def _brev(log_n: int) -> np.ndarray:
+    from .ntt import bitreverse_indices
+
+    return bitreverse_indices(log_n).astype(np.int64)
+
+
+def _pow_table(base: int, count: int) -> np.ndarray:
+    return np.array(gl.powers(base, count), dtype=np.uint64)
+
+
+def _limbs8_np(x: np.ndarray):
+    """(.., ..) u64 -> (8, ..) bf16 planes of 8-bit limbs."""
+    planes = [
+        ((x >> np.uint64(8 * j)) & np.uint64(0xFF)).astype(np.float32)
+        for j in range(8)
+    ]
+    return jnp.asarray(np.stack(planes), dtype=jnp.bfloat16)
+
+
+def _pair_np(x: np.ndarray):
+    lo, hi = limbs.split_np(x)
+    return jnp.asarray(lo), jnp.asarray(hi)
+
+
+class MXUNTTContext:
+    """Baked constant matrices for one (log_R, log_C) split."""
+
+    def __init__(self, log_n: int):
+        assert MIN_LOG_N <= log_n <= MAX_LOG_N
+        self.log_n = log_n
+        self.n = 1 << log_n
+        self.log_R = (log_n + 1) // 2
+        self.log_C = log_n // 2
+        R, C = 1 << self.log_R, 1 << self.log_C
+        self.R, self.C = R, C
+
+        wR = gl.omega(self.log_R)
+        wC = gl.omega(self.log_C)
+        wn = gl.omega(log_n)
+        brR = _brev(self.log_R)
+        brC = _brev(self.log_C)
+        r_idx = np.arange(R, dtype=np.int64)
+        c_idx = np.arange(C, dtype=np.int64)
+
+        powsR = _pow_table(wR, R)
+        powsC = _pow_table(wC, C)
+        powsn = _pow_table(wn, self.n)
+        powsRi = _pow_table(gl.inv(wR), R)
+        powsCi = _pow_table(gl.inv(wC), C)
+        powsni = _pow_table(gl.inv(wn), self.n)
+
+        D_R = powsR[(brR[:, None] * r_idx[None, :]) % R]  # (R, R)
+        D_C = powsC[(brC[:, None] * c_idx[None, :]) % C]  # (C, C)
+        T = powsn[(brR[:, None] * c_idx[None, :]) % self.n]  # (R, C)
+        E_inv = powsCi[(brC[:, None] * c_idx[None, :]) % C]  # (C, C): [c][c']
+        T_inv = powsni[(brR[:, None] * c_idx[None, :]) % self.n]  # (R, C)
+        n_inv = gl.inv(self.n)
+        powsRi_scaled = np.array(
+            [gl.mul(int(v), n_inv) for v in powsRi], dtype=np.uint64
+        )
+        F = powsRi_scaled[(r_idx[:, None] * brR[None, :]) % R]  # (R, R)
+
+        with jax.ensure_compile_time_eval():
+            self.dr = _limbs8_np(D_R)  # (8, R, R)
+            self.dct = _limbs8_np(D_C.T.copy())  # (8, C, C)
+            self.tw = _pair_np(T)
+            self.einv = _limbs8_np(E_inv)
+            self.tw_inv = _pair_np(T_inv)
+            self.f = _limbs8_np(F)
+
+
+@lru_cache(maxsize=None)
+def get_mxu_ctx(log_n: int) -> MXUNTTContext:
+    return MXUNTTContext(log_n)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel exact GL matmul: bf16 limb dots + int32 diagonals + mod-p fold
+# ---------------------------------------------------------------------------
+
+
+def _limb_planes(x):
+    """(lo, hi) u32 pair -> list of 8 bf16 8-bit-limb planes."""
+    planes = []
+    for w in x:
+        for j in range(4):
+            b = (w >> np.uint32(8 * j)) & _MASK8 if j else w & _MASK8
+            # Mosaic has no u32->f32 cast; limbs are < 256 so going through
+            # int32 is exact
+            planes.append(
+                b.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
+            )
+    return planes
+
+
+def _b2u(x):
+    return x.astype(_u32)
+
+
+def _addmod_any(a, b):
+    """(a + b) mod p on u32 pairs, correct for ANY u64 representatives
+    (unlike limbs.add, which assumes canonical inputs). Result < 2^64 and
+    congruent mod p; not necessarily canonical."""
+    lo = a[0] + b[0]
+    c0 = _b2u(lo < b[0])
+    hi_t = a[1] + b[1]
+    c1 = _b2u(hi_t < b[1])
+    hi = hi_t + c0
+    c2 = _b2u(hi < c0)
+    carry = c1 | c2  # the two sub-carries cannot both fire for u64 operands
+    # += carry * eps (2^64 ≡ eps); the +eps can itself wrap once more
+    lo2 = lo - carry
+    d1 = carry & _b2u(lo != 0)
+    c3 = d1 & _b2u(hi == _FULL)
+    hi2 = hi + d1
+    lo3 = lo2 - c3
+    d2 = c3 & _b2u(lo2 != 0)
+    hi3 = hi2 + d2  # cannot wrap a third time: value is < 2^33 by then
+    return lo3, hi3
+
+
+def _eps_times(v):
+    """eps * v as a u64 pair, exact for any u32 v: v*2^32 - v."""
+    return np.uint32(0) - v, v - _b2u(v != 0)
+
+
+def _p_minus_small(v):
+    """p - v for u32 v (v*2^96 ≡ -v mod p)."""
+    lo = _P_LO - v
+    borrow = _b2u(v > 1)
+    return lo, _P_HI - borrow
+
+
+def _p_minus_hi(v):
+    """p - v*2^32 for u32 v (v*2^128 ≡ -v*2^32 mod p)."""
+    return jnp.full_like(v, _P_LO), _P_HI - v
+
+
+def _fold15(Q):
+    """15 int32 diagonal planes (Q_k < 2^31) -> canonical GL (lo, hi) pair.
+
+    W = sum_k Q_k * 2^(8k) accumulated exactly into five u32 words with wrap
+    counters, then folded with 2^64 ≡ eps, 2^96 ≡ -1, 2^128 ≡ -2^32 (mod p).
+    """
+    w = [None] * 5
+    cnt = [None] * 5
+
+    def _add_word(j, val):
+        if w[j] is None:
+            w[j] = val
+            return
+        nw = w[j] + val
+        c = _b2u(nw < val)
+        cnt[j] = c if cnt[j] is None else cnt[j] + c
+        w[j] = nw
+
+    for k in range(15):
+        q = Q[k].astype(_u32)
+        j, m = divmod(k, 4)
+        sh = 8 * m
+        _add_word(j, (q << np.uint32(sh)) if sh else q)
+        if sh:
+            _add_word(j + 1, q >> np.uint32(32 - sh))
+    zero = jnp.zeros_like(Q[0].astype(_u32))
+    for j in range(5):
+        if w[j] is None:
+            w[j] = zero
+    # resolve wrap counters upward (w4 stays tiny: W < 2^140, so no overflow)
+    for j in range(4):
+        if cnt[j] is not None:
+            _add_word(j + 1, cnt[j])
+
+    acc = (w[0], w[1])
+    acc = _addmod_any(acc, _eps_times(w[2]))
+    acc = _addmod_any(acc, _p_minus_small(w[3]))
+    acc = _addmod_any(acc, _p_minus_hi(w[4]))
+    return limbs._canonicalize(*acc)
+
+
+def _gl_matmul(x, dref, side: str):
+    """Exact GL matmul of data pair `x` against baked limb planes `dref`.
+
+    side='left':  result = D @ X   (contract over X's rows)
+    side='right': result = X @ D   (contract over X's cols)
+    """
+    planes = _limb_planes(x)
+    Q = [None] * 15
+    for u in range(8):
+        du = dref[u]
+        for v in range(8):
+            if side == "left":
+                p = jnp.dot(du, planes[v], preferred_element_type=jnp.float32)
+            else:
+                p = jnp.dot(planes[v], du, preferred_element_type=jnp.float32)
+            pi = p.astype(jnp.int32)
+            k = u + v
+            Q[k] = pi if Q[k] is None else Q[k] + pi
+    return _fold15(Q)
+
+
+# ---------------------------------------------------------------------------
+# Kernels
+# ---------------------------------------------------------------------------
+
+
+def _fwd_body(ctx, x, dr, dct, tlo, thi):
+    y = _gl_matmul(x, dr, "left")
+    y = limbs.mul(y, (tlo, thi))
+    return _gl_matmul(y, dct, "right")
+
+
+def _fwd_kernel(ctx, dr, dct, tlo, thi, xl, xh, ol, oh):
+    z = _fwd_body(ctx, (xl[0], xh[0]), dr, dct, tlo[:], thi[:])
+    ol[0] = z[0]
+    oh[0] = z[1]
+
+
+def _fwd_scaled_kernel(ctx, dr, dct, tlo, thi, sl, sh, xl, xh, ol, oh):
+    x = limbs.mul((xl[0], xh[0]), (sl[0], sh[0]))
+    z = _fwd_body(ctx, x, dr, dct, tlo[:], thi[:])
+    ol[0, 0] = z[0]
+    oh[0, 0] = z[1]
+
+
+def _inv_kernel(ctx, einv, f, tlo, thi, xl, xh, ol, oh):
+    y = _gl_matmul((xl[0], xh[0]), einv, "right")
+    y = limbs.mul(y, (tlo[:], thi[:]))
+    z = _gl_matmul(y, f, "left")
+    ol[0] = z[0]
+    oh[0] = z[1]
+
+
+# ---------------------------------------------------------------------------
+# pallas_call wrappers
+# ---------------------------------------------------------------------------
+
+
+def _const_spec(shape):
+    nd = len(shape)
+    return pl.BlockSpec(
+        shape,
+        imap32(lambda *_: (0,) * nd),
+        memory_space=pltpu.VMEM,
+    )
+
+
+def _data_spec(R, C):
+    return pl.BlockSpec(
+        (1, R, C), imap32(lambda b: (b, 0, 0)), memory_space=pltpu.VMEM
+    )
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _fft_planes(planes, log_n: int, interpret: bool):
+    ctx = get_mxu_ctx(log_n)
+    lo, hi = planes
+    B = lo.shape[0]
+    R, C = ctx.R, ctx.C
+    spec = _data_spec(R, C)
+    out_shape = jax.ShapeDtypeStruct((B, R, C), jnp.uint32)
+    return pl.pallas_call(
+        partial(_fwd_kernel, ctx),
+        grid=(B,),
+        out_shape=[out_shape, out_shape],
+        in_specs=[
+            _const_spec((8, R, R)),
+            _const_spec((8, C, C)),
+            _const_spec((R, C)),
+            _const_spec((R, C)),
+            spec,
+            spec,
+        ],
+        out_specs=[spec, spec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(ctx.dr, ctx.dct, *ctx.tw, lo, hi)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _ifft_planes(planes, log_n: int, interpret: bool):
+    ctx = get_mxu_ctx(log_n)
+    lo, hi = planes
+    B = lo.shape[0]
+    R, C = ctx.R, ctx.C
+    spec = _data_spec(R, C)
+    out_shape = jax.ShapeDtypeStruct((B, R, C), jnp.uint32)
+    return pl.pallas_call(
+        partial(_inv_kernel, ctx),
+        grid=(B,),
+        out_shape=[out_shape, out_shape],
+        in_specs=[
+            _const_spec((8, C, C)),
+            _const_spec((8, R, R)),
+            _const_spec((R, C)),
+            _const_spec((R, C)),
+            spec,
+            spec,
+        ],
+        out_specs=[spec, spec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(ctx.einv, ctx.f, *ctx.tw_inv, lo, hi)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _lde_planes(coeff_planes, scale_planes, log_n: int, interpret: bool):
+    """coeffs (B, R, C) x scale (L, R, C) -> (B, L, R, C), scale+NTT fused."""
+    ctx = get_mxu_ctx(log_n)
+    clo, chi = coeff_planes
+    slo, shi = scale_planes
+    B = clo.shape[0]
+    L = slo.shape[0]
+    R, C = ctx.R, ctx.C
+    cspec = pl.BlockSpec(
+        (1, R, C), imap32(lambda b, l: (b, 0, 0)), memory_space=pltpu.VMEM
+    )
+    sspec = pl.BlockSpec(
+        (1, R, C), imap32(lambda b, l: (l, 0, 0)), memory_space=pltpu.VMEM
+    )
+    ospec = pl.BlockSpec(
+        (1, 1, R, C),
+        imap32(lambda b, l: (b, l, 0, 0)),
+        memory_space=pltpu.VMEM,
+    )
+    out_shape = jax.ShapeDtypeStruct((B, L, R, C), jnp.uint32)
+    return pl.pallas_call(
+        partial(_fwd_scaled_kernel, ctx),
+        grid=(B, L),
+        out_shape=[out_shape, out_shape],
+        in_specs=[
+            _const_spec((8, R, R)),
+            _const_spec((8, C, C)),
+            _const_spec((R, C)),
+            _const_spec((R, C)),
+            sspec,
+            sspec,
+            cspec,
+            cspec,
+        ],
+        out_specs=[ospec, ospec],
+        interpret=interpret,
+        compiler_params=None if interpret else _COMPILER_PARAMS,
+    )(ctx.dr, ctx.dct, *ctx.tw, slo, shi, clo, chi)
+
+
+# ---------------------------------------------------------------------------
+# Public entry points (uint64 in / uint64 out)
+# ---------------------------------------------------------------------------
+
+
+def size_fits(n: int) -> bool:
+    return (1 << MIN_LOG_N) <= n <= (1 << MAX_HYBRID_LOG_N)
+
+
+def _to_planes(a: jax.Array, R: int, C: int):
+    lead = a.shape[:-1]
+    flat = a.reshape(-1, R, C)
+    return limbs.split(flat), lead
+
+
+def _from_planes(planes, lead, n):
+    return limbs.join(planes).reshape(lead + (n,))
+
+
+def fft_natural_to_bitreversed(a: jax.Array, interpret: bool = False):
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    if log_n > MAX_LOG_N:
+        return _fft_hybrid(a, log_n, interpret)
+    ctx = get_mxu_ctx(log_n)
+    planes, lead = _to_planes(a, ctx.R, ctx.C)
+    out = _fft_planes(planes, log_n, interpret)
+    return _from_planes(out, lead, n)
+
+
+def ifft_bitreversed_to_natural(a: jax.Array, interpret: bool = False):
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    if log_n > MAX_LOG_N:
+        return _ifft_hybrid(a, log_n, interpret)
+    ctx = get_mxu_ctx(log_n)
+    planes, lead = _to_planes(a, ctx.R, ctx.C)
+    out = _ifft_planes(planes, log_n, interpret)
+    return _from_planes(out, lead, n)
+
+
+def lde_from_monomial(coeffs: jax.Array, scale: jax.Array, interpret: bool = False):
+    """coeffs (..., n), scale (lde, n) -> (..., lde, n); fused scale+NTT."""
+    n = coeffs.shape[-1]
+    log_n = n.bit_length() - 1
+    lde = scale.shape[0]
+    if log_n > MAX_LOG_N:
+        from ..field import goldilocks as gf
+
+        scaled = gf.mul(coeffs[..., None, :], scale)
+        return _fft_hybrid(scaled, log_n, interpret)
+    ctx = get_mxu_ctx(log_n)
+    planes, lead = _to_planes(coeffs, ctx.R, ctx.C)
+    s_planes = limbs.split(scale.reshape(lde, ctx.R, ctx.C))
+    out = _lde_planes(planes, s_planes, log_n, interpret)
+    return limbs.join(out).reshape(lead + (lde, n))
+
+
+# ---------------------------------------------------------------------------
+# Hybrid sizes (2^17..2^22): XLA outer radix-2 stages + per-block kernels
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _fft_hybrid(a: jax.Array, log_n: int, interpret: bool):
+    from .ntt import dif_stages, get_ntt_context
+
+    n = 1 << log_n
+    outer = log_n - MAX_LOG_N
+    ctx = get_ntt_context(log_n)
+    a = dif_stages(a, ctx, 0, outer)
+    lead = a.shape[:-1]
+    blocks = a.reshape(lead + (1 << outer, 1 << MAX_LOG_N))
+    out = fft_natural_to_bitreversed(blocks, interpret)
+    return out.reshape(lead + (n,))
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _ifft_hybrid(a: jax.Array, log_n: int, interpret: bool):
+    from ..field import goldilocks as gf
+    from .ntt import dit_stages, get_ntt_context
+
+    n = 1 << log_n
+    outer = log_n - MAX_LOG_N
+    ctx = get_ntt_context(log_n)
+    lead = a.shape[:-1]
+    blocks = a.reshape(lead + (1 << outer, 1 << MAX_LOG_N))
+    # per-block inverse includes 1/2^16; outer stages + leftover 1/2^outer
+    out = ifft_bitreversed_to_natural(blocks, interpret).reshape(lead + (n,))
+    out = dit_stages(out, ctx, MAX_LOG_N, log_n)
+    return gf.mul(out, jnp.uint64(gl.inv(1 << outer)))

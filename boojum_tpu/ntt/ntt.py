@@ -80,20 +80,23 @@ def get_ntt_context(log_n: int) -> NTTContext:
     return NTTContext(log_n)
 
 
-def _pallas_ntt_ready(n: int, ctx) -> bool:
-    """True when the fused Pallas kernel should take this transform.
+def _mxu_ntt_ready(n: int, ctx) -> bool:
+    """True when the MXU matmul-NTT kernel should take this transform.
 
-    Opt-in (BOOJUM_TPU_PALLAS_NTT=1) while the kernel trails the XLA path:
-    measured on v5e, the fused butterfly chain runs ~1.7x slower than the
-    staged-XLA NTT (the emulated-u64 ops fuse well there); parity is exact,
-    so flipping the default is purely a perf decision."""
+    Default-ON on TPU (the kernel moves the multiply work onto the systolic
+    array and beats the staged-XLA emulated-u64 path; parity is exact);
+    BOOJUM_TPU_MXU_NTT=0 opts out."""
+    import os
+
     from ..utils.pallas_util import pallas_enabled
 
-    if not pallas_enabled("BOOJUM_TPU_PALLAS_NTT"):
+    if os.environ.get("BOOJUM_TPU_MXU_NTT", "").strip() == "0":
         return False
-    from . import pallas_ntt
+    if not pallas_enabled():
+        return False
+    from . import mxu_ntt
 
-    if not pallas_ntt.size_fits(n):
+    if not mxu_ntt.size_fits(n):
         return False
     # custom contexts (non-standard roots) keep the generic path
     return ctx is None or ctx is get_ntt_context(n.bit_length() - 1)
@@ -104,12 +107,12 @@ def fft_natural_to_bitreversed(
 ) -> jax.Array:
     """DIF NTT along the last axis; output in bit-reversed order.
 
-    Dispatches to the fused Pallas kernel on TPU (bit-identical results);
+    Dispatches to the MXU matmul kernel on TPU (bit-identical results);
     the staged-XLA form below is the generic path."""
-    if _pallas_ntt_ready(a.shape[-1], ctx):
-        from . import pallas_ntt
+    if _mxu_ntt_ready(a.shape[-1], ctx):
+        from . import mxu_ntt
 
-        return pallas_ntt.fft_natural_to_bitreversed(a)
+        return mxu_ntt.fft_natural_to_bitreversed(a)
     return fft_natural_to_bitreversed_xla(a, ctx)
 
 
@@ -117,23 +120,23 @@ def ifft_bitreversed_to_natural(
     a: jax.Array, ctx: NTTContext | None = None
 ) -> jax.Array:
     """DIT inverse NTT (incl. 1/n) along the last axis; see the XLA form."""
-    if _pallas_ntt_ready(a.shape[-1], ctx):
-        from . import pallas_ntt
+    if _mxu_ntt_ready(a.shape[-1], ctx):
+        from . import mxu_ntt
 
-        return pallas_ntt.ifft_bitreversed_to_natural(a)
+        return mxu_ntt.ifft_bitreversed_to_natural(a)
     return ifft_bitreversed_to_natural_xla(a, ctx)
 
 
-@partial(jax.jit, static_argnums=(1,))
-def fft_natural_to_bitreversed_xla(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
-    """DIF NTT along the last axis; output in bit-reversed order."""
-    n = a.shape[-1]
-    log_n = n.bit_length() - 1
-    assert 1 << log_n == n
-    if ctx is None:
-        ctx = get_ntt_context(log_n)
+def dif_stages(a: jax.Array, ctx: NTTContext, start: int, end: int) -> jax.Array:
+    """Radix-2 DIF butterfly stages [start, end) of a size-ctx.n transform.
+
+    Stage s combines elements ctx.n >> (s+1) apart; running stages [0, k)
+    leaves 2^k independent plain sub-transforms of size n/2^k — which is
+    what lets the hybrid MXU path (mxu_ntt.py) hand contiguous blocks to
+    the matmul kernel bit-exactly."""
+    n = ctx.n
     lead = a.shape[:-1]
-    for s in range(log_n):
+    for s in range(start, end):
         block = n >> s
         half = block >> 1
         tw = ctx.tw[:: n // block][:half] if half > 1 else ctx.tw[:1]
@@ -144,6 +147,34 @@ def fft_natural_to_bitreversed_xla(a: jax.Array, ctx: NTTContext | None = None) 
         bot = gf.mul(gf.sub(u, v), tw)
         a = jnp.stack([top, bot], axis=-2).reshape(lead + (n,))
     return a
+
+
+def dit_stages(a: jax.Array, ctx: NTTContext, start: int, end: int) -> jax.Array:
+    """Radix-2 DIT butterfly stages [start, end) (no 1/n scaling)."""
+    n = ctx.n
+    lead = a.shape[:-1]
+    for s in range(start, end):
+        block = 2 << s
+        half = block >> 1
+        tw = ctx.itw[:: n // block][:half] if half > 1 else ctx.itw[:1]
+        x = a.reshape(lead + (n // block, 2, half))
+        u = x[..., 0, :]
+        wv = gf.mul(x[..., 1, :], tw)
+        top = gf.add(u, wv)
+        bot = gf.sub(u, wv)
+        a = jnp.stack([top, bot], axis=-2).reshape(lead + (n,))
+    return a
+
+
+@partial(jax.jit, static_argnums=(1,))
+def fft_natural_to_bitreversed_xla(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
+    """DIF NTT along the last axis; output in bit-reversed order."""
+    n = a.shape[-1]
+    log_n = n.bit_length() - 1
+    assert 1 << log_n == n
+    if ctx is None:
+        ctx = get_ntt_context(log_n)
+    return dif_stages(a, ctx, 0, log_n)
 
 
 @partial(jax.jit, static_argnums=(1,))
@@ -157,18 +188,7 @@ def ifft_bitreversed_to_natural_xla(a: jax.Array, ctx: NTTContext | None = None)
     assert 1 << log_n == n
     if ctx is None:
         ctx = get_ntt_context(log_n)
-    lead = a.shape[:-1]
-    for s in range(log_n):
-        block = 2 << s
-        half = block >> 1
-        tw = ctx.itw[:: n // block][:half] if half > 1 else ctx.itw[:1]
-        x = a.reshape(lead + (n // block, 2, half))
-        u = x[..., 0, :]
-        wv = gf.mul(x[..., 1, :], tw)
-        top = gf.add(u, wv)
-        bot = gf.sub(u, wv)
-        a = jnp.stack([top, bot], axis=-2).reshape(lead + (n,))
-    return gf.mul(a, ctx.n_inv)
+    return gf.mul(dit_stages(a, ctx, 0, log_n), ctx.n_inv)
 
 
 def ifft_natural_to_natural(a: jax.Array, ctx: NTTContext | None = None) -> jax.Array:
@@ -246,20 +266,20 @@ def lde_from_monomial(
     all butterfly stages run as ONE fused Pallas kernel per column/coset.
     """
     n = coeffs.shape[-1]
-    if _pallas_ntt_ready(n, None):
-        from . import pallas_ntt
+    if _mxu_ntt_ready(n, None):
+        from . import mxu_ntt
 
         log_n = n.bit_length() - 1
         scale = _lde_scale_cached(log_n, lde_factor, int(coset) % gl.P)
         if coeffs.ndim < 2:
-            return pallas_ntt.lde_from_monomial(coeffs, scale)
+            return mxu_ntt.lde_from_monomial(coeffs, scale)
         B = coeffs.shape[0]
         per = _col_chunks(B, coeffs.size // B * 8 * lde_factor)
         if per is None:
-            return pallas_ntt.lde_from_monomial(coeffs, scale)
+            return mxu_ntt.lde_from_monomial(coeffs, scale)
         return _assemble_chunks(
             coeffs.shape[:-1] + (lde_factor, n),
-            lambda i: pallas_ntt.lde_from_monomial(coeffs[i : i + per], scale),
+            lambda i: mxu_ntt.lde_from_monomial(coeffs[i : i + per], scale),
             range(0, B, per),
         )
     if coeffs.ndim < 2:

@@ -45,6 +45,29 @@ from jax.sharding import Mesh
 from .sharding import make_mesh
 
 
+def _multihost_env_detected() -> bool:
+    """True when the environment advertises a multi-host launch (TPU pod /
+    cluster launcher env vars jax.distributed auto-detects from) — a failed
+    bring-up in such an environment must raise, not degrade silently."""
+    import os
+
+    for var in (
+        "MEGASCALE_COORDINATOR_ADDRESS",
+        "TPU_WORKER_HOSTNAMES",
+        "JAX_COORDINATOR_ADDRESS",
+        "COORDINATOR_ADDRESS",
+        "SLURM_JOB_NUM_NODES",
+        "OMPI_COMM_WORLD_SIZE",
+    ):
+        v = os.environ.get(var, "")
+        if var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
+            if v.isdigit() and int(v) > 1:
+                return True
+        elif v:
+            return True
+    return False
+
+
 def initialize_multihost(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
@@ -68,13 +91,21 @@ def initialize_multihost(
             num_processes=num_processes,
             process_id=process_id,
         )
-    except (RuntimeError, ValueError):
+    except (RuntimeError, ValueError) as e:
         if coordinator_address is not None:
             # an explicitly configured cluster that fails to come up must
             # NOT silently degrade to N duplicate single-process runs
             raise
-        # already initialized, or single-process without a coordinator
-        pass
+        msg = str(e).lower()
+        if "already" in msg and "initial" in msg:
+            pass  # idempotent re-init: fine
+        elif jax.process_count() > 1:
+            pass  # runtime is up despite the error
+        elif num_processes not in (None, 1) or _multihost_env_detected():
+            # a configured OR auto-detected pod bring-up that FAILED must
+            # surface, not degrade every host to a duplicate run
+            raise
+        # else: genuine single-process run without a coordinator
     return jax.process_count() > 1
 
 
@@ -100,10 +131,20 @@ def hybrid_mesh(col_axis_per_host: int | None = None) -> Mesh:
     row_axis = per_host // col_axis_per_host
     # jax.devices() is globally ordered process-major: reshaping
     # (hosts * local_col, local_row) keeps each host's devices contiguous
-    # along 'col'
-    grid = np.array(jax.devices()).reshape(
-        hosts * col_axis_per_host, row_axis
-    )
+    # along 'col'. That ordering is a platform contract, not a law — build
+    # from an explicit (process_index, id) sort and VERIFY the host-local
+    # column-slice invariant rather than assuming it.
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    grid = np.array(devs).reshape(hosts * col_axis_per_host, row_axis)
+    for h in range(hosts):
+        block = grid[h * col_axis_per_host : (h + 1) * col_axis_per_host]
+        owners = {d.process_index for d in block.ravel()}
+        if len(owners) != 1:
+            raise RuntimeError(
+                "hybrid_mesh: device grid is not host-contiguous along "
+                f"'col' (host block {h} spans processes {sorted(owners)}); "
+                "per-column phases would cross DCN"
+            )
     return Mesh(grid, axis_names=("col", "row"))
 
 

@@ -124,13 +124,60 @@ class FriOracles:
         self.final_monomials = None  # host list of (c0, c1)
 
 
-def fri_prove(codeword, transcript, config, base_degree: int) -> FriOracles:
+@lru_cache(maxsize=None)
+def _fri_commit_fn(k: int, cap: int):
+    """Fused oracle commit for one schedule entry: leaf regrouping + leaf
+    hashing + every node layer in ONE dispatch."""
+    from ..merkle import _tree_layers
+
+    @jax.jit
+    def fn(c0, c1):
+        arr = jnp.stack([c0, c1], axis=-1)
+        N = c0.shape[0]
+        leaves = arr.reshape(N >> k, -1)
+        return _tree_layers(leaves, cap)
+
+    return fn
+
+
+@lru_cache(maxsize=None)
+def _fri_fold_fn(k: int):
+    """Fused k-fold for one schedule entry (sub-challenges by squaring)."""
+
+    @jax.jit
+    def fn(c0, c1, ch01, tables):
+        cur = (c0, c1)
+        sub = (ch01[0], ch01[1])
+        for j in range(k):
+            cur = _fold_once_jit(cur, sub, tables[j])
+            sub = ext_f.mul(sub, sub)
+        return cur
+
+    return fn
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(2,))
+def _fri_final_fused(c0, c1, shift_inv: int):
+    """Final-polynomial interpolation (2 iNTTs + coset unshift), fused."""
+    m0 = distribute_powers(ifft_bitreversed_to_natural(c0), shift_inv)
+    m1 = distribute_powers(ifft_bitreversed_to_natural(c1), shift_inv)
+    return m0, m1
+
+
+def fri_prove(
+    codeword, transcript, config, base_degree: int, fused: bool = False
+) -> FriOracles:
     """codeword: ext pair over full LDE domain (brev layout).
 
     Protocol per schedule entry k: commit the current codeword with 2^k
     points per leaf -> absorb cap -> draw ONE challenge -> fold k times with
     challenges ch, ch^2, ch^4, ... -> next entry. Then interpolate the final
-    monomials and absorb them.
+    monomials and absorb them. With `fused`, each entry is two dispatches
+    (commit graph, then fold graph — the challenge only exists after the
+    cap is absorbed).
     """
     out = FriOracles()
     N = int(codeword[0].shape[0])
@@ -146,24 +193,41 @@ def fri_prove(codeword, transcript, config, base_degree: int) -> FriOracles:
     cur = codeword
     fold_round = 0
     for k in schedule:
-        tree = commit_codeword(
-            cur, config.merkle_tree_cap_size, elems_per_leaf=1 << k
-        )
+        if fused:
+            layers = _fri_commit_fn(k, config.merkle_tree_cap_size)(*cur)
+            tree = MerkleTreeWithCap.from_layers(
+                list(layers), config.merkle_tree_cap_size
+            )
+        else:
+            tree = commit_codeword(
+                cur, config.merkle_tree_cap_size, elems_per_leaf=1 << k
+            )
         out.trees.append(tree)
         out.values.append(cur)
         transcript.witness_merkle_tree_cap(tree.get_cap())
         ch = transcript.get_ext_challenge()
         out.challenges.append(ch)
-        sub = ch
-        for _ in range(k):
-            cur = fold_once(cur, sub, tables[fold_round])
-            fold_round += 1
-            sub = ext_f.sqr_s(sub)
+        if fused:
+            ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
+            cur = _fri_fold_fn(k)(
+                cur[0], cur[1], ch01,
+                tuple(tables[fold_round : fold_round + k]),
+            )
+            fold_round += k
+        else:
+            sub = ch
+            for _ in range(k):
+                cur = fold_once(cur, sub, tables[fold_round])
+                fold_round += 1
+                sub = ext_f.sqr_s(sub)
     # final interpolation over coset g^(2^R)·H_{N>>R}
     n_fin = N >> num_folds
     shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
-    mono0 = distribute_powers(ifft_bitreversed_to_natural(cur[0]), shift_inv)
-    mono1 = distribute_powers(ifft_bitreversed_to_natural(cur[1]), shift_inv)
+    if fused:
+        mono0, mono1 = _fri_final_fused(cur[0], cur[1], shift_inv)
+    else:
+        mono0 = distribute_powers(ifft_bitreversed_to_natural(cur[0]), shift_inv)
+        mono1 = distribute_powers(ifft_bitreversed_to_natural(cur[1]), shift_inv)
     m0 = np.asarray(mono0)
     m1 = np.asarray(mono1)
     deg_bound = base_degree >> num_folds

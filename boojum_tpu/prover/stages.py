@@ -37,7 +37,12 @@ from ..cs.gates.base import RowView, TermsCollector
 
 
 def ext_scalar(s):
-    return (jnp.uint64(int(s[0])), jnp.uint64(int(s[1])))
+    """Host (int, int) ext scalar -> pair of u64 array scalars; jax-array
+    components (fused-round tracing) pass through unchanged."""
+    a, b = s[0], s[1]
+    if isinstance(a, jax.Array):
+        return (a, b)
+    return (jnp.uint64(int(a)), jnp.uint64(int(b)))
 
 
 def chunk_columns(num_cols: int, max_degree: int):
@@ -112,16 +117,9 @@ def _z_and_partials(num_all, den_inv_all):
 
 
 def _ext_prefix_prod(a):
-    """Inclusive ext prefix product along the last axis (fused Pallas
-    block-scan on TPU — opt-in, see goldilocks.batch_inverse; log-doubling
-    XLA elsewhere — bit-identical)."""
-    from ..utils.pallas_util import pallas_enabled
-
-    if pallas_enabled("BOOJUM_TPU_PALLAS_SCAN"):
-        from ..field import pallas_scan
-
-        if pallas_scan.size_fits(a[0].shape[-1]) and a[0].ndim == 1:
-            return pallas_scan.ext_prefix_product(a)
+    """Inclusive ext prefix product along the last axis (log-doubling XLA;
+    see goldilocks.batch_inverse for why the Pallas block-scan was
+    retired)."""
     return _ext_prefix_prod_xla(a)
 
 
@@ -224,6 +222,16 @@ class AlphaPows:
         self.p0, self.p1 = ext_powers_device(alpha, cap)
         self.count = count
         self.cursor = 0
+
+    @classmethod
+    def from_arrays(cls, p0, p1, count: int) -> "AlphaPows":
+        """Wrap an existing device power table (fused-round tracing: the
+        table is built once outside and passed as an array argument)."""
+        self = cls.__new__(cls)
+        self.p0, self.p1 = p0, p1
+        self.count = count
+        self.cursor = 0
+        return self
 
     def take(self, k: int):
         """(k,)-shaped ext power pair slice. Over-consumption is a prover

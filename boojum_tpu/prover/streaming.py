@@ -95,12 +95,13 @@ def _sponge_absorb8(state, chunk8):
     return poseidon2_permutation(st)
 
 
-def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:
-    """Merkle-commit the rate-L LDE of `mono` without materializing it.
+def streamed_leaf_digests(mono, L: int):
+    """(N, 4) leaf digests of the rate-L LDE of `mono`, block-streamed.
 
-    Bit-identical to MerkleTreeWithCap(leaf_hash semantics) over the
-    (N, B) leaf matrix: full 8-column chunks absorb in order, the trailing
-    partial chunk zero-pads (the sponge finalize rule)."""
+    Traceable (plain jnp + python loops): callable inside a fused-round jit
+    so the whole commit is one dispatch. Bit-identical to leaf_hash over the
+    materialized (N, B) leaf matrix: full 8-column chunks absorb in order,
+    the trailing partial chunk zero-pads (the sponge finalize rule)."""
     n = mono.shape[-1]
     N = n * L
     state = jnp.zeros((N, 12), jnp.uint64)
@@ -118,7 +119,14 @@ def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:
     if rem is not None:
         pad = jnp.zeros((N, 8 - rem.shape[1]), jnp.uint64)
         state = _sponge_absorb8(state, jnp.concatenate([rem, pad], axis=1))
-    return MerkleTreeWithCap.from_digests(state[:, :4], cap_size)
+    return state[:, :4]
+
+
+def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:
+    """Merkle-commit the rate-L LDE of `mono` without materializing it."""
+    return MerkleTreeWithCap.from_digests(
+        streamed_leaf_digests(mono, L), cap_size
+    )
 
 
 def deep_source_blocks(sources, per_bytes: int):

@@ -36,12 +36,28 @@ def log(msg: str):
         print(f"[boojum_tpu] {msg}", file=sys.stderr, flush=True)
 
 
+_STAGE_SINK: list | None = None
+
+
+def collect_stages() -> list:
+    """Start collecting (stage, seconds) tuples from stage_timer into a
+    fresh list (bench.py uses this for the per-stage split it emits)."""
+    global _STAGE_SINK
+    _STAGE_SINK = []
+    return _STAGE_SINK
+
+
+def stop_collecting_stages():
+    global _STAGE_SINK
+    _STAGE_SINK = None
+
+
 @contextlib.contextmanager
 def stage_timer(name: str):
     """Wall-clock a prover stage; also opens a jax.profiler trace context
     when BOOJUM_TPU_JAX_TRACE points at a directory."""
     trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
-    if not profiling_enabled() and not trace_dir:
+    if not profiling_enabled() and not trace_dir and _STAGE_SINK is None:
         yield
         return
     ctx = contextlib.nullcontext()
@@ -52,4 +68,7 @@ def stage_timer(name: str):
     t0 = time.perf_counter()
     with ctx:
         yield
-    log(f"{name}: {time.perf_counter() - t0:.3f}s")
+    dt = time.perf_counter() - t0
+    if _STAGE_SINK is not None:
+        _STAGE_SINK.append((name, dt))
+    log(f"{name}: {dt:.3f}s")

@@ -1,0 +1,125 @@
+"""Isolate MXU-NTT kernel cost components on the real TPU.
+
+Variants (all grid=(B,), (256,256) tiles, B=64):
+  dots:     64 bf16 dots only, i32-summed into one plane
+  diag:     64 dots + 15-diagonal i32 accumulation (no fold)
+  pass1:    limb extract + dots + diagonals + fold15  (one GL matmul)
+  passes:   pass1 + twiddle mul + pass2 (the full fwd kernel)
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from boojum_tpu.field import gl, limbs
+from boojum_tpu.ntt import mxu_ntt as M
+from boojum_tpu.utils.pallas_util import imap32
+
+log_n = 16
+ctx = M.get_mxu_ctx(log_n)
+R, C = ctx.R, ctx.C
+B = 64
+
+rng = np.random.default_rng(0)
+a = rng.integers(0, gl.P, size=(B, 1 << log_n), dtype=np.uint64)
+planes = limbs.split(jnp.asarray(a.reshape(B, R, C)))
+
+
+def _dots_kernel(mode, dr, dct, tlo, thi, xl, xh, ol, oh):
+    x = (xl[0], xh[0])
+    if mode == "dots":
+        pl_ = M._limb_planes(x)
+        acc = None
+        for u in range(8):
+            for v in range(8):
+                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.float32)
+                pi = p.astype(jnp.int32)
+                acc = pi if acc is None else acc + pi
+        ol[0] = acc.astype(jnp.uint32)
+        oh[0] = acc.astype(jnp.uint32)
+    elif mode == "diag":
+        pl_ = M._limb_planes(x)
+        Q = [None] * 15
+        for u in range(8):
+            for v in range(8):
+                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.float32)
+                pi = p.astype(jnp.int32)
+                k = u + v
+                Q[k] = pi if Q[k] is None else Q[k] + pi
+        acc = Q[0]
+        for k in range(1, 15):
+            acc = acc + Q[k]
+        ol[0] = acc.astype(jnp.uint32)
+        oh[0] = acc.astype(jnp.uint32)
+    elif mode == "pass1":
+        y = M._gl_matmul(x, dr, "left")
+        ol[0] = y[0]
+        oh[0] = y[1]
+    elif mode == "passes":
+        y = M._gl_matmul(x, dr, "left")
+        y = limbs.mul(y, (tlo[:], thi[:]))
+        z = M._gl_matmul(y, dct, "right")
+        ol[0] = z[0]
+        oh[0] = z[1]
+    elif mode == "fold":
+        # extraction + fold cost without matmuls: fake diagonals from limbs
+        pl_ = M._limb_planes(x)
+        Q = [
+            (pl_[k % 8].astype(jnp.float32) * 7.0).astype(jnp.int32)
+            for k in range(15)
+        ]
+        y = M._fold15(Q)
+        ol[0] = y[0]
+        oh[0] = y[1]
+    elif mode == "twiddle":
+        y = limbs.mul(x, (tlo[:], thi[:]))
+        ol[0] = y[0]
+        oh[0] = y[1]
+
+
+def make(mode):
+    spec = M._data_spec(R, C)
+    out_shape = jax.ShapeDtypeStruct((B, R, C), jnp.uint32)
+
+    @jax.jit
+    def run(lo, hi):
+        return pl.pallas_call(
+            partial(_dots_kernel, mode),
+            grid=(B,),
+            out_shape=[out_shape, out_shape],
+            in_specs=[
+                M._const_spec((8, R, R)),
+                M._const_spec((8, C, C)),
+                M._const_spec((R, C)),
+                M._const_spec((R, C)),
+                spec,
+                spec,
+            ],
+            out_specs=[spec, spec],
+            compiler_params=M._COMPILER_PARAMS,
+        )(ctx.dr, ctx.dct, *ctx.tw, lo, hi)
+
+    return run
+
+
+for mode in ("twiddle", "fold", "dots", "diag", "pass1", "passes"):
+    f = make(mode)
+    out = f(*planes)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    reps = 8
+    for _ in range(reps):
+        out = f(*planes)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / reps
+    print(f"{mode:8s}: {dt*1e3:8.2f} ms  ({dt/B*1e6:7.1f} us/col)")

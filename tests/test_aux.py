@@ -61,6 +61,49 @@ def test_external_witness_reprove():
     assert proof.to_json() == prove(asm, setup, CONFIG).to_json()
 
 
+def test_external_witness_reprove_changed_values():
+    """A re-witnessed assembly must NOT inherit the prover's device-upload
+    cache: proving asm (populating the cache) then proving a derived
+    assembly with DIFFERENT witness values has to commit the new columns
+    (regression: CSAssembly(**__dict__) shares the cache dict)."""
+    from test_e2e import CONFIG, build_fibonacci_circuit
+    from boojum_tpu.prover import generate_setup, prove, verify
+
+    from test_e2e import GEOM
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.gates import (
+        BooleanConstraintGate,
+        FmaGate,
+        PublicInputGate,
+        SelectionGate,
+    )
+
+    def build(a0, b0):
+        cs = ConstraintSystem(GEOM, 1 << 10)
+        a = cs.alloc_variable_with_value(a0)
+        b = cs.alloc_variable_with_value(b0)
+        flag = cs.alloc_variable_with_value(1)
+        BooleanConstraintGate.enforce(cs, flag)
+        for _ in range(5):
+            a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+        sel = SelectionGate.select(cs, flag, a, b)
+        PublicInputGate.place(cs, sel)
+        return cs
+
+    asm = build(1, 2).into_assembly()
+    setup = generate_setup(asm, CONFIG)
+    p1 = prove(asm, setup, CONFIG)  # populates asm's device cache
+    # identical circuit STRUCTURE, different witness values: the two
+    # synthesis runs place variables identically, so the second circuit's
+    # witness vector drops into the first assembly
+    wv2 = build(5, 9).into_assembly().witness_vec()
+    asm2 = asm.with_external_witness(wv2)
+    p2 = prove(asm2, setup, CONFIG)
+    assert verify(setup.vk, p2, asm.gates)
+    assert p2.public_inputs != p1.public_inputs
+    assert p2.witness_cap != p1.witness_cap
+
+
 def test_stage_timers_emit():
     from boojum_tpu.utils import profiling
 

@@ -111,61 +111,68 @@ class TestPoseidon2Kernel:
         assert np.array_equal(np.asarray(got), np.asarray(want))
 
 
-class TestNTTKernel:
-    LOG_N = 11  # smallest pallas-dispatched size; exercises row+lane stages
+class TestMXUNTTKernel:
+    """Bit-parity of the MXU matmul-NTT (ntt/mxu_ntt.py) vs the staged-XLA
+    path. Interpret mode executes the same exact-integer bf16/f32/i32 ops on
+    CPU, so equality here pins the kernel's arithmetic, including the 8-bit
+    limb dots and the 15-diagonal mod-p fold."""
 
-    @slow_only
+    LOG_N = 14  # smallest MXU-dispatched size
+
+    def _data(self, log_n, cols=2, seed=30):
+        a = _rand((cols, 1 << log_n), seed)
+        # adversarial rows: all p-1 (max limbs everywhere) and small values
+        a[0, :] = gl.P - 1
+        return jnp.asarray(a)
+
     def test_fwd_inv_interpret(self):
         from boojum_tpu.ntt import ntt
-        from boojum_tpu.ntt import pallas_ntt as pntt
+        from boojum_tpu.ntt import mxu_ntt
 
-        a = jnp.asarray(_rand((1, 1 << self.LOG_N), 30))
+        a = self._data(self.LOG_N)
         want = ntt.fft_natural_to_bitreversed_xla(a)
-        got = pntt.fft_natural_to_bitreversed(a, interpret=True)
+        got = mxu_ntt.fft_natural_to_bitreversed(a, interpret=True)
         assert np.array_equal(np.asarray(got), np.asarray(want))
         wanti = ntt.ifft_bitreversed_to_natural_xla(want)
-        goti = pntt.ifft_bitreversed_to_natural(want, interpret=True)
+        goti = mxu_ntt.ifft_bitreversed_to_natural(want, interpret=True)
         assert np.array_equal(np.asarray(goti), np.asarray(wanti))
 
     @slow_only
+    def test_fwd_inv_interpret_all_sizes(self):
+        from boojum_tpu.ntt import ntt
+        from boojum_tpu.ntt import mxu_ntt
+
+        for log_n in (15, 16):
+            a = self._data(log_n, cols=1, seed=31 + log_n)
+            want = ntt.fft_natural_to_bitreversed_xla(a)
+            got = mxu_ntt.fft_natural_to_bitreversed(a, interpret=True)
+            assert np.array_equal(np.asarray(got), np.asarray(want)), log_n
+            wanti = ntt.ifft_bitreversed_to_natural_xla(want)
+            goti = mxu_ntt.ifft_bitreversed_to_natural(want, interpret=True)
+            assert np.array_equal(np.asarray(goti), np.asarray(wanti)), log_n
+
+    @slow_only
+    def test_hybrid_interpret(self):
+        """2^17: one XLA outer stage + two per-block 2^16 kernels."""
+        from boojum_tpu.ntt import ntt
+        from boojum_tpu.ntt import mxu_ntt
+
+        a = self._data(17, cols=1, seed=33)
+        want = ntt.fft_natural_to_bitreversed_xla(a)
+        got = mxu_ntt.fft_natural_to_bitreversed(a, interpret=True)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+        wanti = ntt.ifft_bitreversed_to_natural_xla(want)
+        goti = mxu_ntt.ifft_bitreversed_to_natural(want, interpret=True)
+        assert np.array_equal(np.asarray(goti), np.asarray(wanti))
+
     def test_lde_interpret(self):
         from boojum_tpu.ntt import ntt
-        from boojum_tpu.ntt import pallas_ntt as pntt
+        from boojum_tpu.ntt import mxu_ntt
 
-        co = jnp.asarray(_rand((1, 1 << self.LOG_N), 31))
+        co = self._data(self.LOG_N, cols=1, seed=34)
         want = ntt._lde_from_monomial_jit(co, 4)
         scale = ntt._lde_scale_cached(
             self.LOG_N, 4, gl.MULTIPLICATIVE_GENERATOR % gl.P
         )
-        got = pntt.lde_from_monomial(co, scale, interpret=True)
+        got = mxu_ntt.lde_from_monomial(co, scale, interpret=True)
         assert np.array_equal(np.asarray(got), np.asarray(want))
-
-
-class TestScanKernels:
-    @slow_only
-    def test_prefix_and_inverse_interpret(self):
-        from boojum_tpu.field import pallas_scan as ps
-
-        a = jnp.asarray(
-            np.maximum(_rand((2, 1 << 13), 40), np.uint64(1))
-        )
-        got = ps.prefix_product(a, interpret=True)
-        want = gf.prefix_product(a)
-        assert np.array_equal(np.asarray(got), np.asarray(want))
-        got = ps.batch_inverse(a, interpret=True)
-        want = gf.batch_inverse_xla(a)
-        assert np.array_equal(np.asarray(got), np.asarray(want))
-
-    @slow_only
-    def test_ext_prefix_interpret(self):
-        from boojum_tpu.field import pallas_scan as ps
-        from boojum_tpu.prover import stages
-
-        pair = (
-            jnp.asarray(_rand((1 << 13,), 41)),
-            jnp.asarray(_rand((1 << 13,), 42)),
-        )
-        got = ps.ext_prefix_product(pair, interpret=True)
-        want = stages._ext_prefix_prod_xla(pair)
-        for g, w in zip(got, want):
-            assert np.array_equal(np.asarray(g), np.asarray(w))

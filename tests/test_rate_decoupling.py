@@ -94,3 +94,19 @@ def test_decoupled_with_lookups():
     q = proof.queries[0].stage2
     q.leaf_values[-1] = (q.leaf_values[-1] + 1) % ((1 << 64) - (1 << 32) + 1)
     assert not verify(setup.vk, proof, asm.gates)
+
+
+def test_streamed_lde_proof_byte_identical(monkeypatch):
+    """BOOJUM_TPU_STREAM_LDE=1 forces the streamed commit/DEEP/query path
+    (load-bearing for the 2^20 result); its proof must be BYTE-identical to
+    the materialized path's — block ordering, trailing-chunk sponge padding
+    and the per-column query regeneration are all pinned by this."""
+    cfg = ProofConfig(fri_lde_factor=2, num_queries=10, fri_final_degree=8)
+    cs = _fma_circuit()
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, cfg)
+    baseline = prove(asm, setup, cfg)
+    monkeypatch.setenv("BOOJUM_TPU_STREAM_LDE", "1")
+    streamed = prove(asm, setup, cfg)
+    assert streamed.to_json() == baseline.to_json()
+    assert verify(setup.vk, streamed, asm.gates)
