@@ -175,3 +175,211 @@ def capture_all(gates, constants_by_gate=None) -> dict:
     """Programs for a whole gate set (reference GatesSetForGPU,
     gpu_synthesizer/mod.rs:446)."""
     return {g.name: capture_gate_program(g) for g in gates}
+
+
+# ---------------------------------------------------------------------------
+# Scanned playback: O(1)-size compiled graphs for huge gate programs
+# ---------------------------------------------------------------------------
+# The prover's gate sweep normally traces gate.evaluate() directly, so the
+# compiled graph grows with the evaluator's op count — for permutation-sized
+# gates (the recursion circuit's flattened Poseidon2: thousands of field
+# ops) XLA optimization time explodes super-linearly (the round-2 recursive
+# prove never finished compiling). `pack_for_scan` register-allocates the
+# SSA program (linear-scan liveness, so the live set stays near the gate's
+# state width instead of one slot per op) and `scan_evaluate` replays it
+# under ONE jax.lax.scan whose body is a single add/sub/mul switch — the
+# graph size is constant in the program length. Bit-identical to direct
+# tracing: same ops, same order, exact integer arithmetic.
+
+from dataclasses import dataclass as _dataclass
+
+
+@_dataclass
+class PackedGateProgram:
+    gate_name: str
+    num_regs: int
+    # ops: (T, 4) int32 [opcode(0=add,1=sub,2=mul), dst, a, b]
+    ops_arr: object
+    v_idx: tuple
+    v_regs: tuple
+    w_idx: tuple
+    w_regs: tuple
+    c_idx: tuple
+    c_regs: tuple
+    const_vals: tuple  # python ints
+    const_regs: tuple
+    term_regs: tuple
+    num_ops: int
+
+
+def pack_for_scan(prog: GateProgram) -> PackedGateProgram:
+    """Lower a GateProgram to the register form scan_evaluate replays."""
+    # prelower neg/double onto {add, sub, mul}; neg needs a zero constant
+    consts = list(prog.consts)
+    ops = []
+    zero_slot = None
+    for op, dst, a, b in prog.ops:
+        if op == "neg":
+            if zero_slot is None:
+                zero_slot = prog.num_slots
+                consts.append((zero_slot, 0))
+            ops.append(("sub", dst, zero_slot, a))
+        elif op == "double":
+            ops.append(("add", dst, a, a))
+        else:
+            ops.append((op, dst, a, b))
+    num_slots = prog.num_slots + (1 if zero_slot is not None else 0)
+
+    # liveness: last position (op index) each slot is read; terms live forever
+    last_use = [-1] * num_slots
+    for t, (_op, _dst, a, b) in enumerate(ops):
+        last_use[a] = t
+        last_use[b] = t
+    INF = len(ops) + 1
+    for s in prog.terms:
+        last_use[s] = INF
+
+    # linear-scan allocation. Initial definitions (loads/consts) take regs
+    # up front; an op's dst may reuse a reg freed at THIS op (operands are
+    # read before the write in the scan body).
+    reg_of = {}
+    free: list = []
+    next_reg = 0
+
+    def alloc(slot):
+        nonlocal next_reg
+        if free:
+            r = free.pop()
+        else:
+            r = next_reg
+            next_reg += 1
+        reg_of[slot] = r
+        return r
+
+    initial_defs = [s for (s, _k, _i) in prog.loads] + [
+        s for (s, _v) in consts
+    ]
+    for s in initial_defs:
+        alloc(s)
+    # free initial defs never read at all (dead loads)
+    for s in list(initial_defs):
+        if last_use[s] < 0:
+            free.append(reg_of[s])
+    packed_ops = []
+    for t, (op, dst, a, b) in enumerate(ops):
+        ra, rb = reg_of[a], reg_of[b]
+        # free operands whose last read is this op (dst may take the reg)
+        for s in {a, b}:
+            if last_use[s] == t:
+                free.append(reg_of[s])
+        rd = alloc(dst)
+        if last_use[dst] < 0:  # dead op (term-less side effect): keep reg
+            last_use[dst] = INF
+        packed_ops.append(
+            ({"add": 0, "sub": 1, "mul": 2}[op], rd, ra, rb)
+        )
+
+    import numpy as _np
+
+    v_loads = [(i, reg_of[s]) for (s, k, i) in prog.loads if k == "v"]
+    w_loads = [(i, reg_of[s]) for (s, k, i) in prog.loads if k == "w"]
+    c_loads = [(i, reg_of[s]) for (s, k, i) in prog.loads if k == "c"]
+    return PackedGateProgram(
+        gate_name=prog.gate_name,
+        num_regs=next_reg,
+        ops_arr=_np.array(packed_ops, dtype=_np.int32).reshape(-1, 4),
+        v_idx=tuple(i for i, _r in v_loads),
+        v_regs=tuple(r for _i, r in v_loads),
+        w_idx=tuple(i for i, _r in w_loads),
+        w_regs=tuple(r for _i, r in w_loads),
+        c_idx=tuple(i for i, _r in c_loads),
+        c_regs=tuple(r for _i, r in c_loads),
+        const_vals=tuple(v for (_s, v) in consts),
+        const_regs=tuple(reg_of[s] for (s, _v) in consts),
+        term_regs=tuple(reg_of[s] for s in prog.terms),
+        num_ops=len(packed_ops),
+    )
+
+
+def scan_evaluate(packed: PackedGateProgram, row: RowView):
+    """Replay a packed program over (n,)-array row values with lax.scan.
+
+    Returns the term arrays, equal to gate.evaluate(ArrayOps, ...)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..field import goldilocks as gf
+
+    sample = None
+    loads = []
+    for idx, reg, getter in (
+        [(i, r, row.v) for i, r in zip(packed.v_idx, packed.v_regs)]
+        + [(i, r, row.w) for i, r in zip(packed.w_idx, packed.w_regs)]
+        + [(i, r, row.c) for i, r in zip(packed.c_idx, packed.c_regs)]
+    ):
+        val = getter(idx)
+        sample = val
+        loads.append((reg, val))
+    assert sample is not None, packed.gate_name
+    n = sample.shape[-1]
+    regs = jnp.zeros((packed.num_regs, n), jnp.uint64)
+    if loads:
+        regs = regs.at[jnp.asarray([r for r, _v in loads])].set(
+            jnp.stack([jnp.broadcast_to(v, (n,)) for _r, v in loads])
+        )
+    if packed.const_vals:
+        cvals = jnp.asarray(
+            _np_array_u64(packed.const_vals)
+        )
+        regs = regs.at[jnp.asarray(packed.const_regs)].set(
+            jnp.broadcast_to(cvals[:, None], (len(packed.const_vals), n))
+        )
+
+    ops_dev = jnp.asarray(packed.ops_arr)
+
+    def step(regs, op):
+        a = regs[op[2]]
+        b = regs[op[3]]
+        res = jax.lax.switch(
+            op[0],
+            (
+                lambda x, y: gf.add(x, y),
+                lambda x, y: gf.sub(x, y),
+                lambda x, y: gf.mul(x, y),
+            ),
+            a,
+            b,
+        )
+        regs = jax.lax.dynamic_update_index_in_dim(regs, res, op[1], 0)
+        return regs, None
+
+    regs, _ = jax.lax.scan(step, regs, ops_dev)
+    return [regs[r] for r in packed.term_regs]
+
+
+def _np_array_u64(vals):
+    import numpy as _np
+
+    return _np.array([int(v) % gl.P for v in vals], dtype=_np.uint64)
+
+
+_PACKED_CACHE: dict = {}
+
+
+def packed_program_for(gate, threshold: int | None = None):
+    """The packed program for `gate` when its op count exceeds the scan
+    threshold (BOOJUM_TPU_SCAN_GATE_THRESHOLD, default 256); None for small
+    gates, which stay on the direct-trace path."""
+    import os
+
+    if threshold is None:
+        threshold = int(
+            os.environ.get("BOOJUM_TPU_SCAN_GATE_THRESHOLD", "256")
+        )
+    key = (gate.name, threshold)
+    if key not in _PACKED_CACHE:
+        prog = capture_gate_program(gate)
+        _PACKED_CACHE[key] = (
+            pack_for_scan(prog) if len(prog.ops) > threshold else None
+        )
+    return _PACKED_CACHE[key]

@@ -22,3 +22,4 @@ from .simple import (
 from .u32 import U32AddGate, U32SubGate, U32FmaGate, U32TriAddCarryAsChunkGate, UIntXAddGate
 from .ext_fma import ExtFmaGate
 from .poseidon2_flat import Poseidon2FlattenedGate
+from .poseidon_flat import PoseidonFlattenedGate

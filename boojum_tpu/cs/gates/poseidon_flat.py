@@ -1,0 +1,166 @@
+"""Legacy Poseidon flattened gate: one full width-12 permutation per row.
+
+Counterpart of `/root/reference/src/cs/gates/poseidon.rs:1249`
+(`PoseidonFlattenedGate` — the whole LEGACY Poseidon permutation inscribed
+across one row, used by legacy-recursion-mode circuits). Same degree-reset
+construction as the Poseidon2 gate (`poseidon2_flat.py`): an auxiliary
+variable is placed wherever the running state expression would exceed
+degree 7, contributing `state_expr - aux = 0`, and the traversal resumes
+from the fresh variable.
+
+Legacy schedule (hashes/poseidon.py, Plonky2-compatible): NO initial
+external MDS; 4 full rounds (RC + x^7 on all lanes + circulant MDS), 22
+partial rounds (RC on all lanes, x^7 on lane 0, MDS), 4 full rounds.
+Resets: all 12 lanes before full rounds 1..3 (36), lane 0's s-box input in
+every partial round (22), all 12 lanes before each tail full round (48) —
+106 aux, so the gate spans 12 + 12 + 106 = 130 copy columns, the same
+occupancy as the Poseidon2 gate (and the Era recursion geometry).
+
+The SAME traversal drives the constraint evaluator and the witness
+resolver, so they cannot drift.
+"""
+
+from __future__ import annotations
+
+from ...field import gl
+from ...hashes import poseidon2_params as params
+from ...hashes.poseidon import MDS_MATRIX_EXPS
+from .base import Gate
+from .poseidon2_flat import _pow7
+
+SW = 12
+HALF_FULL = 4
+NUM_PARTIAL = 22
+
+_RC = [
+    [int(c) for c in params.ALL_ROUND_CONSTANTS[12 * r : 12 * r + 12]]
+    for r in range(30)
+]
+
+NUM_AUX = (HALF_FULL - 1) * SW + NUM_PARTIAL + HALF_FULL * SW  # 106
+WIDTH = 2 * SW + NUM_AUX  # 130
+
+
+def _circulant_mds(ops, s):
+    """M·s with the power-of-two circulant (suggested_mds.rs:11): constant
+    multiplications keep the constraint degree unchanged."""
+    out = []
+    for r in range(SW):
+        acc = None
+        for c in range(SW):
+            term = ops.mul(
+                s[c], ops.constant(1 << MDS_MATRIX_EXPS[(c - r) % SW])
+            )
+            acc = term if acc is None else ops.add(acc, term)
+        out.append(acc)
+    return out
+
+
+def legacy_flat_permutation(ops, state, reset):
+    """Legacy Poseidon permutation with a `reset(value) -> value` hook at
+    every degree-reset point (see module docstring for the schedule)."""
+    for r in range(HALF_FULL):
+        if r != 0:
+            state = [reset(v) for v in state]
+        state = [
+            _pow7(ops, ops.add(v, ops.constant(_RC[r][i])))
+            for i, v in enumerate(state)
+        ]
+        state = _circulant_mds(ops, state)
+    for p in range(NUM_PARTIAL):
+        rc = _RC[HALF_FULL + p]
+        state = [
+            ops.add(v, ops.constant(rc[i])) for i, v in enumerate(state)
+        ]
+        state[0] = _pow7(ops, reset(state[0]))
+        state = _circulant_mds(ops, state)
+    for r in range(HALF_FULL):
+        state = [reset(v) for v in state]
+        rc = _RC[HALF_FULL + NUM_PARTIAL + r]
+        state = [
+            _pow7(ops, ops.add(v, ops.constant(rc[i])))
+            for i, v in enumerate(state)
+        ]
+        state = _circulant_mds(ops, state)
+    return state
+
+
+def _witness_trace(input_values):
+    """(outputs, aux_values) of one legacy permutation over scalars."""
+    from ..field_like import ScalarOps
+
+    aux = []
+
+    def reset(v):
+        aux.append(v)
+        return v
+
+    out = legacy_flat_permutation(
+        ScalarOps, [v % gl.P for v in input_values], reset
+    )
+    return out, aux
+
+
+class PoseidonFlattenedGate(Gate):
+    name = "poseidon_flat"
+    principal_width = WIDTH
+    num_terms = NUM_AUX + SW
+    max_degree = 7
+
+    def evaluate(self, ops, row, dst):
+        state = [row.v(i) for i in range(SW)]
+        output = [row.v(SW + i) for i in range(SW)]
+        cursor = [2 * SW]
+
+        def reset(v):
+            aux = row.v(cursor[0])
+            cursor[0] += 1
+            dst.push(ops.sub(v, aux))
+            return aux
+
+        state = legacy_flat_permutation(ops, state, reset)
+        assert cursor[0] == WIDTH
+        for s, o in zip(state, output):
+            dst.push(ops.sub(o, s))
+
+    def padding_instance(self, cs, constants=()):
+        zero = cs.zero_var()
+        ins = [zero] * SW
+        outs, aux = _witness_trace([0] * SW)
+        vals = outs + aux
+        places = cs.alloc_multiple_variables_without_values(len(vals))
+        cs.set_values_with_dependencies(
+            [], list(places), lambda _, vals=vals: list(vals)
+        )
+        return ins + list(places)
+
+    @staticmethod
+    def permutation(cs, input_vars):
+        """Allocate and constrain output = legacy_poseidon(input); returns
+        the 12 output variables (the legacy round function's circuit form,
+        reference poseidon.rs:1249 + gadgets/poseidon/mod.rs)."""
+        assert len(input_vars) == SW
+        outs = cs.alloc_multiple_variables_without_values(SW)
+        auxs = cs.alloc_multiple_variables_without_values(NUM_AUX)
+
+        def resolve(vals):
+            out, aux = _witness_trace(list(vals))
+            return out + aux
+
+        cs.set_values_with_dependencies(
+            list(input_vars), list(outs) + list(auxs), resolve
+        )
+        cs.place_gate(
+            PoseidonFlattenedGate.instance(),
+            list(input_vars) + list(outs) + list(auxs),
+            (),
+        )
+        return list(outs)
+
+    _inst = None
+
+    @classmethod
+    def instance(cls):
+        if cls._inst is None:
+            cls._inst = cls()
+        return cls._inst

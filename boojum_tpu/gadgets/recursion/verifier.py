@@ -128,16 +128,18 @@ def recursive_verify(cs, vk, proof, gates):
         "the in-circuit verifier replays the Poseidon2 transcript only "
         "(the reference's recursion-compatible transcript configuration)"
     )
-    assert not (lookups and not lp.use_specialized_columns), (
-        "the in-circuit verifier supports specialized-columns lookups only "
-        "(general-purpose-columns recursion is a round-3 item)"
-    )
+    lk_specialized = lookups and lp.use_specialized_columns
     M = 1 if lookups else 0
-    R = lp.num_repetitions if lookups else 0
     wdt = lp.width if lookups else 0
-    K = geometry.num_constant_columns + (1 if lookups else 0)
+    if lk_specialized:
+        R = lp.num_repetitions
+    elif lookups:
+        R = Cg // wdt  # general mode: sub-arguments tile general columns
+    else:
+        R = 0
+    K = geometry.num_constant_columns + (1 if lk_specialized else 0)
     TW = (wdt + 1) if lookups else 0
-    assert Ct == (Cg + R * wdt if lookups else Cg)
+    assert Ct == (Cg + R * wdt if lk_specialized else Cg)
     assert [g.name for g in gates] == list(vk.gate_names)
     assert len(proof.public_inputs) == len(vk.public_input_locations)
 
@@ -255,23 +257,50 @@ def recursive_verify(cs, vk, proof, gates):
         )
         total = ops.add(total, ops.mul(rel, next(alpha_pows)))
 
-    # lookup terms at z + the sum check at 0
+    # lookup terms at z + the sum check at 0 (both placement families —
+    # reference lookup_placement.rs:21 + recursive_verifier.rs:380)
     if lookups:
         ab_off = 2 * (1 + (num_chunks - 1))
         gpow = [ops.one()]
         for _ in range(wdt + 1):
             gpow.append(ops.mul(gpow[-1], lookup_gamma))
-        tid_at_z = const_vals[K - 1]
+        if lk_specialized:
+            tid_at_z = const_vals[K - 1]
+            a_numerator = ops.one()
+            col_base = Cg
+        else:
+            # general mode: the table id is the marker row's constant and
+            # each A relation is gated by the marker's SELECTOR at z
+            mk_gid = next(
+                (
+                    i for i, g in enumerate(gates)
+                    if getattr(g, "is_lookup_marker", False)
+                ),
+                None,
+            )
+            assert mk_gid is not None, (
+                "general-mode VK but no marker gate supplied"
+            )
+            mk_path = vk.selector_paths[mk_gid]
+            tid_at_z = const_vals[len(mk_path)]
+            sel_at_z = ops.one()
+            for bdx, bit in enumerate(mk_path):
+                cb = const_vals[bdx]
+                sel_at_z = ops.mul(
+                    sel_at_z, cb if bit else ops.sub(ops.one(), cb)
+                )
+            a_numerator = sel_at_z
+            col_base = 0
         for i in range(R):
             a_i = _ext_from_pair(
                 ops, s2_vals[ab_off + 2 * i], s2_vals[ab_off + 2 * i + 1]
             )
             den = lookup_beta
             for j in range(wdt):
-                wv = wit_vals[Cg + i * wdt + j]
+                wv = wit_vals[col_base + i * wdt + j]
                 den = ops.add(den, ops.mul(gpow[j], wv))
             den = ops.add(den, ops.mul(gpow[wdt], tid_at_z))
-            rel = ops.sub(ops.mul(a_i, den), ops.one())
+            rel = ops.sub(ops.mul(a_i, den), a_numerator)
             total = ops.add(total, ops.mul(rel, next(alpha_pows)))
         b_at_z = _ext_from_pair(
             ops, s2_vals[ab_off + 2 * R], s2_vals[ab_off + 2 * R + 1]

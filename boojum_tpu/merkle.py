@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .hashes.poseidon2 import leaf_hash, node_hash, Poseidon2SpongeHost
+from .parallel.sharding import host_np as _host_np
 
 
 # Levels at or below this node count are fused into one compiled graph:
@@ -79,7 +80,7 @@ class MerkleTreeWithCap:
         self.cap_size = cap_size
         self.layers = list(_tree_layers(leaf_values, cap_size))
         self._cap_host = [
-            tuple(int(x) for x in row) for row in np.asarray(self.layers[-1])
+            tuple(int(x) for x in row) for row in _host_np(self.layers[-1])
         ]
 
     @classmethod
@@ -97,7 +98,7 @@ class MerkleTreeWithCap:
         tree.num_leaves = n
         tree.layers = list(_node_layers(digests, cap_size))
         tree._cap_host = [
-            tuple(int(x) for x in row) for row in np.asarray(tree.layers[-1])
+            tuple(int(x) for x in row) for row in _host_np(tree.layers[-1])
         ]
         return tree
 
@@ -110,7 +111,7 @@ class MerkleTreeWithCap:
         tree.num_leaves = int(layers[0].shape[0])
         tree.layers = list(layers)
         tree._cap_host = [
-            tuple(int(x) for x in row) for row in np.asarray(layers[-1])
+            tuple(int(x) for x in row) for row in _host_np(layers[-1])
         ]
         return tree
 
@@ -150,7 +151,7 @@ class MerkleTreeWithCap:
         dominate, on local hardware it is still fewer, larger transfers.
         Returns a list of paths aligned with leaf_indices."""
         pending, assemble = self.proof_gathers(leaf_indices)
-        levels = [np.asarray(x) for x in jax.device_get(pending)]
+        levels = [_host_np(x) for x in pending]
         return assemble(levels)
 
     def get_proof(self, leaf_idx: int):

@@ -80,9 +80,15 @@ def initialize_multihost(
     explicitly supports CPU/GPU clusters (reference: jax.distributed docs).
     A second call is a no-op (jax.distributed tolerates re-init only via
     its own error, which we swallow to keep driver scripts idempotent)."""
+    # Detect an already-initialized distributed runtime WITHOUT touching
+    # jax.process_count(): that would initialize the local backend, after
+    # which jax.distributed.initialize() hard-fails ("must be called before
+    # any JAX computations").
     try:
-        if jax.process_count() > 1:
-            return True
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is not None:
+            return jax.process_count() > 1
     except Exception:
         pass
     try:

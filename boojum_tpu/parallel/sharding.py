@@ -257,3 +257,22 @@ def sharded_prove_fragment(mesh: Mesh, lde_factor: int = 4, cap_size: int = 4):
         return cap, ratio_z(num_p, den_inv)
 
     return run
+
+
+def host_np(x):
+    """np.asarray that also works for MULTI-PROCESS global arrays: a
+    sharded jax.Array spanning non-addressable devices cannot be fetched
+    directly (jax raises), so gather it to every host first. Single-process
+    (and plain numpy/host values) pass straight through."""
+    try:
+        if (
+            isinstance(x, jax.Array)
+            and jax.process_count() > 1
+            and not x.is_fully_addressable
+        ):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    except Exception:
+        pass
+    return np.asarray(x)

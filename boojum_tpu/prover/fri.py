@@ -228,8 +228,10 @@ def fri_prove(
     else:
         mono0 = distribute_powers(ifft_bitreversed_to_natural(cur[0]), shift_inv)
         mono1 = distribute_powers(ifft_bitreversed_to_natural(cur[1]), shift_inv)
-    m0 = np.asarray(mono0)
-    m1 = np.asarray(mono1)
+    from ..parallel.sharding import host_np
+
+    m0 = host_np(mono0)
+    m1 = host_np(mono1)
     deg_bound = base_degree >> num_folds
     assert (m0[deg_bound:] == 0).all() and (m1[deg_bound:] == 0).all(), (
         "final FRI polynomial exceeds degree bound"

@@ -1022,23 +1022,24 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         z01 = jnp.asarray(np.array([z_chal[0], z_chal[1]], dtype=np.uint64))
         zw01 = jnp.asarray(np.array([zw[0], zw[1]], dtype=np.uint64))
         ev0, ev1, evw0, evw1 = _evals_fused(all_mono, s2_mono, z01, zw01)
-        ev0, ev1, evw0, evw1 = jax.device_get((ev0, ev1, evw0, evw1))
     else:
         z_pows = ext_powers_device(z_chal, n)
         ev0, ev1 = eval_monomial_at_ext_point(all_mono, z_chal, z_pows)
         zw_pows = ext_powers_device(zw, n)
         evw0, evw1 = eval_monomial_at_ext_point(s2_mono[:2], zw, zw_pows)
+    from ..parallel.sharding import host_np
+
     values_at_z = [
-        (int(a), int(b)) for a, b in zip(np.asarray(ev0), np.asarray(ev1))
+        (int(a), int(b)) for a, b in zip(host_np(ev0), host_np(ev1))
     ]
     values_at_z_omega = [
-        (int(a), int(b)) for a, b in zip(np.asarray(evw0), np.asarray(evw1))
+        (int(a), int(b)) for a, b in zip(host_np(evw0), host_np(evw1))
     ]
     # lookup sum openings at 0: ext value of each A_i/B pair is the pair of
     # constant monomial coefficients
     values_at_0 = []
     if lookups:
-        s2_mono_host = np.asarray(s2_mono[:, 0])
+        s2_mono_host = host_np(s2_mono[:, 0])
         ab_off = 2 + 2 * num_partials
         for i in range(R_args + 1):
             values_at_0.append(
@@ -1261,7 +1262,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ONE fused gather dispatch + ONE host transfer
     arrs_, idxs_, axes_ = zip(*plans)
-    flat = np.asarray(
+    flat = host_np(
         _gather_flat_fused(tuple(arrs_), tuple(idxs_), tuple(axes_))
     )
     _plan_offsets = np.concatenate(

@@ -293,6 +293,8 @@ def gate_terms_contribution(
 
 
 def _build_gate_sweep(gates, selector_paths, geometry):
+    from ..cs.gate_capture import packed_program_for, scan_evaluate
+
     def core(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1):
         t = 0
         acc = None
@@ -301,6 +303,11 @@ def _build_gate_sweep(gates, selector_paths, geometry):
                 continue
             sel = selector_poly_lde(const_lde_flat, selector_paths[gid])
             reps = gate.num_repetitions(geometry)
+            # permutation-sized gate programs replay under ONE lax.scan
+            # (constant graph size) instead of unrolling thousands of field
+            # ops into the trace — the recursion circuit's flattened
+            # Poseidon2 gate made the unrolled sweep uncompilable
+            packed = packed_program_for(gate)
             gate_acc = None
             for inst in range(reps):
                 row = LdeRowView(
@@ -313,10 +320,14 @@ def _build_gate_sweep(gates, selector_paths, geometry):
                     # right after ITS OWN path bits
                     len(selector_paths[gid]),
                 )
-                dst = TermsCollector()
-                gate.evaluate(ArrayOps, row, dst)
-                assert len(dst.terms) == gate.num_terms, gate.name
-                for term in dst.terms:
+                if packed is not None:
+                    terms = scan_evaluate(packed, row)
+                else:
+                    dst = TermsCollector()
+                    gate.evaluate(ArrayOps, row, dst)
+                    terms = dst.terms
+                assert len(terms) == gate.num_terms, gate.name
+                for term in terms:
                     gate_acc = accumulate_ext(gate_acc, term, (a0[t], a1[t]))
                     t += 1
             if gate_acc is not None:

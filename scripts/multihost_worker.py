@@ -1,0 +1,109 @@
+"""Worker process for the 2-process jax.distributed multihost tests.
+
+Launched by tests/test_multihost.py with:
+  python scripts/multihost_worker.py <mode> <port> <pid> <nprocs> <out.json>
+
+Brings up jax.distributed over localhost (CPU backend, 2 virtual devices per
+process), runs the requested DCN mode, and writes its result JSON. Modes:
+  proofs  — distribute_proofs: this process proves its slice of a 3-job
+            queue (proof-parallel; no cross-process collectives)
+  hybrid  — hybrid_mesh: one proof whose mesh 'col' axis spans both
+            processes (GSPMD collectives cross the process boundary)
+"""
+
+import json
+import os
+import sys
+
+# must run BEFORE jax import: local CPU with 2 devices per process
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=2"
+).strip()
+os.environ.pop("PYTHONSTARTUP", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+from jax._src import xla_bridge
+
+jax.config.update("jax_platforms", "cpu")
+xla_bridge._backend_factories.pop("axon", None)
+_cache = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+jax.config.update("jax_compilation_cache_dir", _cache)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+def build_circuit(seed: int):
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry
+
+    cs = ConstraintSystem(CSGeometry(8, 0, 6, 4), 1 << 10)
+    a = cs.alloc_variable_with_value(1 + seed)
+    b = cs.alloc_variable_with_value(2 + seed)
+    for _ in range(300):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    return cs
+
+
+def main():
+    mode, port, pid, nprocs, out_path = (
+        sys.argv[1],
+        int(sys.argv[2]),
+        int(sys.argv[3]),
+        int(sys.argv[4]),
+        sys.argv[5],
+    )
+    from boojum_tpu.parallel.multihost import (
+        distribute_proofs,
+        hybrid_mesh,
+        initialize_multihost,
+    )
+
+    active = initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=pid,
+    )
+    assert active, "jax.distributed did not come up multi-process"
+    assert jax.process_count() == nprocs
+
+    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+
+    cfg = ProofConfig(fri_lde_factor=4, num_queries=8, fri_final_degree=8)
+
+    result = {"pid": pid, "process_count": jax.process_count()}
+    if mode == "proofs":
+        jobs = [0, 1, 2]
+
+        def prove_job(seed):
+            asm = build_circuit(seed).into_assembly()
+            setup = generate_setup(asm, cfg)
+            proof = prove(asm, setup, cfg)
+            assert verify(setup.vk, proof, asm.gates)
+            return proof.to_json()
+
+        mine = distribute_proofs(jobs, prove_job)
+        result["proofs"] = {str(i): p for i, p in mine}
+    elif mode == "hybrid":
+        mesh = hybrid_mesh(col_axis_per_host=2)
+        assert mesh.shape["col"] == nprocs * 2, dict(mesh.shape)
+        asm = build_circuit(0).into_assembly()
+        setup = generate_setup(asm, cfg)
+        proof = prove(asm, setup, cfg, mesh=mesh)
+        result["proof"] = proof.to_json()
+    else:
+        raise SystemExit(f"unknown mode {mode}")
+
+    with open(out_path, "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -158,3 +158,44 @@ def test_derive_gadget():
     from boojum_tpu.prover.satisfiability import check_if_satisfied
 
     assert check_if_satisfied(cs.into_assembly())
+
+
+def test_scan_playback_matches_direct_trace():
+    """pack_for_scan + scan_evaluate must be bit-identical to tracing the
+    gate evaluator directly over arrays — this is what lets the prover
+    sweep permutation-sized gates with constant graph size."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from boojum_tpu.cs.gate_capture import (
+        capture_gate_program,
+        pack_for_scan,
+        scan_evaluate,
+    )
+    from boojum_tpu.cs.field_like import ArrayOps
+    from boojum_tpu.cs.gates import FmaGate, Poseidon2FlattenedGate
+    from boojum_tpu.cs.gates.base import RowView, TermsCollector
+    from boojum_tpu.field import gl
+
+    rng = np.random.default_rng(99)
+    n = 128
+    for gate, width, consts in (
+        (FmaGate.instance(), 4, (5, 11)),
+        (Poseidon2FlattenedGate.instance(), 130, ()),
+    ):
+        cols = jnp.asarray(
+            rng.integers(0, gl.P, size=(width, n), dtype=np.uint64)
+        )
+        cvals = [jnp.full((n,), np.uint64(c)) for c in consts]
+        row = RowView(
+            lambda i, _c=cols: _c[i],
+            lambda i: None,
+            lambda i, _k=cvals: _k[i],
+        )
+        direct = TermsCollector()
+        gate.evaluate(ArrayOps, row, direct)
+        packed = pack_for_scan(capture_gate_program(gate))
+        scanned = scan_evaluate(packed, row)
+        assert len(scanned) == len(direct.terms), gate.name
+        for s, d in zip(scanned, direct.terms):
+            assert np.array_equal(np.asarray(s), np.asarray(d)), gate.name

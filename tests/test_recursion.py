@@ -102,11 +102,6 @@ import os
 import pytest
 
 
-@pytest.mark.skipif(
-    not os.environ.get("BOOJUM_TPU_SLOW_TESTS"),
-    reason="full 130-column recursive prove takes many minutes on 1 CPU; "
-    "set BOOJUM_TPU_SLOW_TESTS=1 to run",
-)
 def test_recursive_proof_proves_and_verifies():
     """The counterpart of the reference's recursive bench
     (sha256_bench_recursive_poseidon2.sh / recursive_verifier.rs:2213
@@ -143,3 +138,43 @@ def test_recursive_proof_proves_and_verifies():
     # the outer proof's public inputs surface the inner ones
     surfaced = [pi[2] for pi in outer_asm.public_inputs[: len(pi_vars)]]
     assert surfaced == list(proof.public_inputs)
+
+
+def test_recursive_verifier_general_lookup_mode():
+    """In-circuit verification of a GENERAL-purpose-columns lookup proof
+    (reference lookup_placement.rs:21 + recursive_verifier.rs:380): the
+    A-relations are gated by the marker gate's selector at z and the table
+    id comes from the marker row's constant. Satisfiable on the honest
+    proof; unsatisfiable when a lookup opening is tampered."""
+    import sys as _sys
+
+    _sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from test_lookup_general import CONFIG as GL_CONFIG, build_circuit
+
+    cs, _ = build_circuit(num_lookups=12)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, GL_CONFIG)
+    proof = prove(asm, setup, GL_CONFIG)
+    assert verify(setup.vk, proof, asm.gates)
+
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    pi_vars, _cap = recursive_verify(outer, setup.vk, proof, asm.gates)
+    assert [outer.get_value(v) for v in pi_vars] == list(proof.public_inputs)
+    assert check_if_satisfied(outer.into_assembly(), verbose=True)
+
+    # tampered lookup A-opening must be unsatisfiable
+    bad = Proof.from_json(proof.to_json())
+    num_chunks = 2  # 8 copy cols at max degree 4 -> 2 chunks
+    ab_off_abs = (
+        2 * setup.vk.num_copy_cols
+        + setup.vk.num_wit_cols
+        + 1  # multiplicities column opening
+        + setup.vk.geometry.num_constant_columns
+        + (setup.vk.lookup_params.width + 1)
+        + 2 * (1 + (num_chunks - 1))
+    )
+    c0, c1 = bad.values_at_z[ab_off_abs]
+    bad.values_at_z[ab_off_abs] = ((c0 + 1) % gl.P, c1)
+    outer2 = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    recursive_verify(outer2, setup.vk, bad, asm.gates)
+    assert not check_if_satisfied(outer2.into_assembly())
