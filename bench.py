@@ -39,8 +39,11 @@ def _enable_compile_cache():
     try:
         import jax
 
+        # NOT the tests' .jax_cache: the axon remote compile service runs
+        # on a different host, and its CPU-flavored AOT entries SIGILL the
+        # local machine when the CPU test suite loads them
         cache = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"
         )
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -2,6 +2,7 @@ from .base import Gate, RowView, TermsCollector
 from .simple import (
     FmaGate,
     ConstantsAllocatorGate,
+    ExplicitConstantsAllocatorGate,
     BooleanConstraintGate,
     NopGate,
     PublicInputGate,

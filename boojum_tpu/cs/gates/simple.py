@@ -748,3 +748,53 @@ class MatrixMultiplicationGate(Gate):
         cs.set_values_with_dependencies(list(ins), outs, resolve)
         cs.place_gate(self, list(ins) + outs, ())
         return outs
+
+
+class ExplicitConstantsAllocatorGate(Gate):
+    """Constants allocated purely as baked-literal constraints — no constant
+    COLUMNS consumed (reference
+    constants_allocator_as_explicit_constraint.rs: always adds 0, 1 and -1,
+    plus an arbitrary set; per-set instances carry a unique name the way the
+    reference carries unique_identifier)."""
+
+    witness_width = 0
+    num_constants = 0
+    max_degree = 1
+
+    def __init__(self, constants_set=()):
+        consts = [0, 1, gl.P - 1] + [int(c) % gl.P for c in constants_set]
+        self.constants = consts
+        self.principal_width = len(consts)
+        self.num_terms = len(consts)
+        self.name = (
+            "explicit_constants["
+            + ",".join(str(c) for c in consts[3:])
+            + "]"
+        )
+
+    def evaluate(self, ops, row, dst):
+        for i, c in enumerate(self.constants):
+            dst.push(ops.sub(row.v(i), ops.constant(c)))
+
+    def padding_instance(self, cs, constants=()):
+        vals = list(self.constants)
+        places = cs.alloc_multiple_variables_without_values(len(vals))
+        cs.set_values_with_dependencies(
+            [], list(places), lambda _, v=vals: list(v)
+        )
+        return list(places)
+
+    @staticmethod
+    def allocate(cs, constants_set=()):
+        """Place one instance; returns {constant_value: variable} covering
+        0, 1, p-1 and every value in constants_set."""
+        gate = ExplicitConstantsAllocatorGate(constants_set)
+        variables = []
+        for c in gate.constants:
+            v = cs.alloc_variable_without_value()
+            cs.set_values_with_dependencies(
+                [], [v], lambda _, c=c: [c]
+            )
+            variables.append(v)
+        cs.place_gate(gate, list(variables), ())
+        return dict(zip(gate.constants, variables))

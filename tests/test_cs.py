@@ -295,3 +295,48 @@ def test_bounded_gate_wrapper():
         bounded.place(cs, [a, a, a, d], (1, 1))
     assert cs.next_row == rows_before  # nothing was placed
     assert check_if_satisfied(cs.into_assembly(), verbose=True)
+
+
+def test_explicit_constants_allocator_gate():
+    """ExplicitConstantsAllocatorGate (reference
+    constants_allocator_as_explicit_constraint.rs): allocates 0/1/-1 plus a
+    set as baked-literal constraints with ZERO constant columns; proves
+    e2e and rejects a tampered constant."""
+    from boojum_tpu.cs.gates import (
+        ExplicitConstantsAllocatorGate,
+        FmaGate,
+        PublicInputGate,
+    )
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.field import gl
+    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+    from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+    geom = CSGeometry(8, 0, 6, 4)
+    cs = ConstraintSystem(geom, 1 << 10)
+    table = ExplicitConstantsAllocatorGate.allocate(cs, (5, 1 << 32))
+    assert cs.get_value(table[0]) == 0
+    assert cs.get_value(table[1]) == 1
+    assert cs.get_value(table[gl.P - 1]) == gl.P - 1
+    assert cs.get_value(table[5]) == 5
+    a = table[5]
+    b = table[1 << 32]
+    out = a
+    for _ in range(300):
+        out = FmaGate.fma(cs, out, b, a, 1, 1)
+    PublicInputGate.place(cs, out)
+    asm = cs.into_assembly()
+    assert check_if_satisfied(asm)
+    cfg = ProofConfig(fri_lde_factor=4, num_queries=8, fri_final_degree=8)
+    setup = generate_setup(asm, cfg)
+    proof = prove(asm, setup, cfg)
+    assert verify(setup.vk, proof, asm.gates)
+
+    # tamper the allocated constant's witness value -> unsatisfiable
+    import numpy as np
+
+    loc = np.argwhere(asm.copy_placement == table[5])
+    c, r = loc[0]
+    asm.copy_cols_values[c, r] = 6
+    assert not check_if_satisfied(asm)
