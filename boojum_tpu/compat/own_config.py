@@ -1,0 +1,85 @@
+"""Verifier gate configuration for circuits built by THIS framework.
+
+The reference-dialect verifier (`compat.verifier._verify_impl`) consumes a
+`config` dict of gate evaluators in the compat adapter shape (`num_terms`,
+`per_chunk`, `num_repetitions(geom)`, `load_shared`, `evaluate_once`) — the
+same shape `era_main_vm_verifier_config` hand-writes for the golden Era
+main-VM artifacts. For OWN circuits the gate set is known exactly: this
+module wraps each `boojum_tpu.cs.gates.Gate` instance in that adapter shape
+by re-running its single `evaluate(ops, row, dst)` definition over
+`ExtScalarOps` (the verifier-side face of the field-like contract) — so the
+reference-dialect prover and verifier agree on term order by construction.
+
+Counterpart: the reference's `GateConstraintEvaluator` instances recovered
+from a `Verifier` (`/root/reference/src/cs/implementations/verifier.rs:130`
+`new_from_parameters`).
+"""
+
+from __future__ import annotations
+
+from ..cs.field_like import ExtScalarOps
+from ..cs.gates.base import RowView, TermsCollector
+
+
+class _GeomShim:
+    def __init__(self, geom_dict):
+        self.num_columns_under_copy_permutation = geom_dict[
+            "num_columns_under_copy_permutation"
+        ]
+        self.num_witness_columns = geom_dict["num_witness_columns"]
+        self.num_constant_columns = geom_dict["num_constant_columns"]
+
+
+class OwnGateAdapter:
+    """Compat-verifier evaluator over one of this framework's gates.
+
+    `per_chunk` constants are 0: this framework shares a row's gate
+    constants across instance chunks (the verifier's `const(i)` then
+    resolves relative to the selector-path offset for every repetition,
+    matching `prover.verifier._ZRowView`).
+    """
+
+    def __init__(self, gate):
+        self.gate = gate
+        self.num_terms = gate.num_terms
+        self.per_chunk = (gate.principal_width, gate.witness_width, 0)
+
+    def num_repetitions(self, geom):
+        return self.gate.num_repetitions(_GeomShim(geom))
+
+    @staticmethod
+    def load_shared(const):
+        return None
+
+    def evaluate_once(self, var, wit, const, shared, push):
+        row = RowView(var, wit, const)
+        dst = TermsCollector()
+        self.gate.evaluate(ExtScalarOps, row, dst)
+        assert len(dst.terms) == self.num_terms, self.gate.name
+        for term in dst.terms:
+            push(term)
+
+
+def verifier_config_for_assembly(assembly) -> dict:
+    """Reference-dialect verifier config for an own assembly.
+
+    All of this framework's gates place general-purpose (specialized
+    columns are used only by lookups, which the verifier handles
+    separately), so `specialized_gates` is empty and the general-purpose
+    list is the assembly's gate list in selector-tree order (gate index i
+    == position i, the same indexing `setup.build_selector_tree` uses).
+    Zero-term gates (nop, public-input, lookup markers) get a `None`
+    evaluator exactly like the reference's nop row.
+    """
+    gp = []
+    for g in assembly.gates:
+        if g.num_terms == 0:
+            gp.append((g.name, None))
+        else:
+            if g.witness_width:
+                # the compat verifier's wit() accessor carries no per-rep
+                # offset (mirroring the reference closure), so witness-
+                # column gates must occupy the row alone
+                assert g.num_repetitions(assembly.geometry) == 1, g.name
+            gp.append((g.name, OwnGateAdapter(g)))
+    return {"general_purpose_gates": gp, "specialized_gates": []}

@@ -70,9 +70,15 @@ def era_main_vm_verifier_config():
     }
 
 
-def make_non_residues(num: int, domain_size: int) -> list[int]:
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def make_non_residues(num: int, domain_size: int) -> tuple[int, ...]:
     """Reference utils.rs:636 — successive integers that are quadratic
-    non-residues and lie in distinct multiplicative cosets of the domain."""
+    non-residues and lie in distinct multiplicative cosets of the domain.
+    Cached: the reference-dialect prover hits this once per quotient-coset
+    point through `t_accumulator_at`."""
     out: list[int] = []
     current = 1
     legendre_exp = (gl.P - 1) // 2
@@ -86,11 +92,22 @@ def make_non_residues(num: int, domain_size: int) -> list[int]:
         if any(gl.pow_(t, domain_size) == tmp for t in out):
             continue
         out.append(current)
-    return out
+    return tuple(out)
 
 
 def non_residues_for_copy_permutation(domain_size: int, num_columns: int):
-    return [1] + make_non_residues(num_columns - 1, domain_size)
+    return [1] + list(make_non_residues(num_columns - 1, domain_size))
+
+
+def pow_seed_challenges(t):
+    """Transcript challenges seeding the Blake2s PoW (verifier.rs:1960):
+    256/CHAR_BITS = 4 challenges, plus one because 4 % CHAR_BITS != 0 — a
+    reference quirk kept for byte parity. Shared by the verifier and the
+    reference-dialect prover so the two transcripts cannot desynchronize."""
+    num_chal = 256 // 64
+    if num_chal % 64 != 0:
+        num_chal += 1
+    return t.get_multiple_challenges(num_chal)
 
 
 def compute_fri_schedule(
@@ -209,43 +226,12 @@ def verify_reference_proof(
         return False
 
 
-def _verify_impl(vk, proof, config, check_quotient_identity):
-    if config is None:
-        config = era_main_vm_verifier_config()
-
+def derive_counts(vk, config):
+    """Poly/term counts the reference derives from VK + gate config
+    (verifier.rs:888 locals). Shared between `_verify_impl` and the
+    reference-dialect prover (`compat.prove_reference`) so both sides
+    agree on leaf widths, opening counts and challenge partition sizes."""
     lp = vk.lookup_parameters
-    pc = proof.proof_config
-    if vk.cap_size != pc["merkle_tree_cap_size"]:
-        return False
-    if vk.fri_lde_factor != pc["fri_lde_factor"]:
-        return False
-    if vk.cap_size != len(vk.setup_merkle_tree_cap):
-        return False
-    if len(proof.public_inputs) != len(vk.public_inputs_locations):
-        return False
-
-    t = ReferenceTranscript()
-    t.witness_merkle_tree_cap(vk.setup_merkle_tree_cap)
-    public_inputs_with_values = []
-    for (column, row), value in zip(
-        vk.public_inputs_locations, proof.public_inputs
-    ):
-        public_inputs_with_values.append((column, row, value))
-        t.witness_field_elements([value])
-    if vk.cap_size != len(proof.witness_oracle_cap):
-        return False
-    t.witness_merkle_tree_cap(proof.witness_oracle_cap)
-    beta = (t.get_challenge(), t.get_challenge())
-    gamma = (t.get_challenge(), t.get_challenge())
-    if lp.is_lookup:
-        lookup_beta = (t.get_challenge(), t.get_challenge())
-        lookup_gamma = (t.get_challenge(), t.get_challenge())
-    if vk.cap_size != len(proof.stage_2_oracle_cap):
-        return False
-    t.witness_merkle_tree_cap(proof.stage_2_oracle_cap)
-    alpha = (t.get_challenge(), t.get_challenge())
-
-    # ---- counts -----------------------------------------------------------
     num_lookup_subarguments = lp.num_repetitions if lp.is_lookup else 0
     num_multiplicities_polys = 1 if lp.is_lookup else 0
     total_num_lookup_argument_terms = (
@@ -316,41 +302,6 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
         + 1
         + num_intermediate
     )
-    # alpha powers [1, a, a^2, ...] split per term family
-    powers = [ONE]
-    for _ in range(1, total_num_terms):
-        powers.append(e_mul(powers[-1], alpha))
-    lookup_challenges = powers[:total_num_lookup_argument_terms]
-    off = total_num_lookup_argument_terms
-    specialized_challenges = powers[off : off + total_spec_terms]
-    off += total_spec_terms
-    general_challenges = powers[off : off + total_gp_terms]
-    off += total_gp_terms
-    remaining_challenges = powers[off:]
-
-    if vk.cap_size != len(proof.quotient_oracle_cap):
-        return False
-    t.witness_merkle_tree_cap(proof.quotient_oracle_cap)
-    z = (t.get_challenge(), t.get_challenge())
-    for v in proof.values_at_z:
-        t.witness_field_elements(v)
-    for v in proof.values_at_z_omega:
-        t.witness_field_elements(v)
-    for v in proof.values_at_0:
-        t.witness_field_elements(v)
-
-    omega = gl.omega(vk.domain_size.bit_length() - 1)
-    # public input opening tuples grouped by opening point
-    public_input_opening_tuples = []
-    for column, row, value in public_inputs_with_values:
-        open_at = gl.pow_(omega, row)
-        for el in public_input_opening_tuples:
-            if el[0] == open_at:
-                el[1].append((column, value))
-                break
-        else:
-            public_input_opening_tuples.append([open_at, [(column, value)]])
-
     expected_lookup_polys_total = (
         (
             num_lookup_subarguments
@@ -371,32 +322,76 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
         + expected_lookup_polys_total
         + quotient_degree
     )
-    if len(proof.values_at_z) != num_poly_values_at_z:
-        return False
-    if len(proof.values_at_z_omega) != 1:
-        return False
-    if len(proof.values_at_0) != total_num_lookup_argument_terms:
-        return False
+    return {
+        "num_lookup_subarguments": num_lookup_subarguments,
+        "num_multiplicities_polys": num_multiplicities_polys,
+        "total_num_lookup_argument_terms": total_num_lookup_argument_terms,
+        "lookup_specialized_vars": lookup_specialized_vars,
+        "lookup_specialized_constants": lookup_specialized_constants,
+        "num_variable_polys": num_variable_polys,
+        "num_witness_polys": num_witness_polys,
+        "num_constant_polys": num_constant_polys,
+        "num_copy_permutation_polys": num_copy_permutation_polys,
+        "num_intermediate": num_intermediate,
+        "quotient_degree": quotient_degree,
+        "geom": geom,
+        "total_gp_terms": total_gp_terms,
+        "total_spec_terms": total_spec_terms,
+        "total_num_terms": total_num_terms,
+        "expected_lookup_polys_total": expected_lookup_polys_total,
+        "num_poly_values_at_z": num_poly_values_at_z,
+    }
 
-    # ---- quotient identity at z ------------------------------------------
-    it = iter(proof.values_at_z)
 
-    def take(n):
-        return [next(it) for _ in range(n)]
+def split_alpha_powers(alpha, counts):
+    """[1, a, a^2, ...] partitioned lookup | specialized | general | rest
+    (copy-permutation) — the reference challenge consumption order."""
+    powers = [ONE]
+    for _ in range(1, counts["total_num_terms"]):
+        powers.append(e_mul(powers[-1], alpha))
+    tl = counts["total_num_lookup_argument_terms"]
+    ts = counts["total_spec_terms"]
+    tg = counts["total_gp_terms"]
+    return {
+        "lookup": powers[:tl],
+        "specialized": powers[tl : tl + ts],
+        "general": powers[tl + ts : tl + ts + tg],
+        "remaining": powers[tl + ts + tg :],
+    }
 
-    variables_polys_values = take(num_variable_polys)
-    witness_polys_values = take(num_witness_polys)
-    constant_poly_values = take(num_constant_polys)
-    sigmas_values = take(num_copy_permutation_polys)
-    copy_permutation_z_at_z = take(1)[0]
-    grand_product_intermediate_polys = take(num_intermediate)
-    multiplicities_polys_values = take(num_multiplicities_polys)
-    lookup_witness_encoding_polys_values = take(num_lookup_subarguments)
-    multiplicities_encoding_polys_values = take(num_multiplicities_polys)
-    lookup_tables_columns = take((lp.width + 1) if lp.is_lookup else 0)
-    quotient_chunks = list(it)
-    assert len(quotient_chunks) == quotient_degree
-    copy_permutation_z_at_z_omega = proof.values_at_z_omega[0]
+
+def t_accumulator_at(point, opened, ch, vk, config, counts):
+    """The quotient-identity numerator T(x) at one evaluation point
+    (verifier.rs:1242-1650): lookup terms, specialized-gate terms,
+    general-purpose gate terms (selector-gated), and the copy-permutation
+    terms, each weighted by its alpha-power partition.
+
+    `point`: ext (c0, c1) evaluation point (z for the verifier; quotient-
+    coset points for the reference-dialect prover).
+    `opened`: dict of poly values at `point` — keys variables, witness,
+    constants, sigmas, copy_z, copy_z_shifted, intermediates,
+    multiplicities, lookup_a, mult_encoding, tables (lists of ext tuples).
+    `ch`: dict with beta, gamma, lookup_beta, lookup_gamma and the alpha
+    partitions from `split_alpha_powers`.
+    """
+    lp = vk.lookup_parameters
+    spec_gates = config["specialized_gates"]
+    gp_gates = config["general_purpose_gates"]
+    geom = counts["geom"]
+    quotient_degree = counts["quotient_degree"]
+    num_lookup_subarguments = counts["num_lookup_subarguments"]
+
+    variables_polys_values = opened["variables"]
+    witness_polys_values = opened["witness"]
+    constant_poly_values = opened["constants"]
+    sigmas_values = opened["sigmas"]
+    copy_permutation_z_at_z = opened["copy_z"]
+    copy_permutation_z_at_z_omega = opened["copy_z_shifted"]
+    grand_product_intermediate_polys = opened["intermediates"]
+    multiplicities_polys_values = opened["multiplicities"]
+    lookup_witness_encoding_polys_values = opened["lookup_a"]
+    multiplicities_encoding_polys_values = opened["mult_encoding"]
+    lookup_tables_columns = opened["tables"]
 
     t_accumulator = ZERO
 
@@ -411,16 +406,8 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
             assert g is None or g.num_terms == 0, _name
 
     if lp.is_lookup:
-        # sumcheck: sum A_i(0) == sum B(0)
-        a_sum = ZERO
-        for v in proof.values_at_0[:num_lookup_subarguments]:
-            a_sum = e_add(a_sum, v)
-        b_sum = ZERO
-        for v in proof.values_at_0[num_lookup_subarguments:]:
-            b_sum = e_add(b_sum, v)
-        if a_sum != b_sum:
-            return False
-
+        lookup_beta = ch["lookup_beta"]
+        lookup_gamma = ch["lookup_gamma"]
         assert lp.mode.startswith("UseSpecializedColumns"), (
             "only the specialized-columns lookup mode is implemented"
         )
@@ -438,7 +425,7 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
             lookup_table_columns_aggregated = e_add(
                 lookup_table_columns_aggregated, e_mul(gpow, column)
             )
-        ch_it = iter(lookup_challenges)
+        ch_it = iter(ch["lookup"])
         base = vk.num_columns_under_copy_permutation
         variables_for_lookup = variables_polys_values[
             base : base + col_per_subarg * num_lookup_subarguments
@@ -473,8 +460,11 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
 
     # specialized gates (each with selector ONE, own column subranges)
     ch_off = 0
-    var_off = vk.num_columns_under_copy_permutation + lookup_specialized_vars
-    const_off = constants_for_gp + lookup_specialized_constants
+    var_off = (
+        vk.num_columns_under_copy_permutation
+        + counts["lookup_specialized_vars"]
+    )
+    const_off = constants_for_gp + counts["lookup_specialized_constants"]
     for (_name, g, reps, share) in spec_gates:
         vw, ww, cw = g.per_chunk
         gate_acc = ZERO
@@ -497,14 +487,14 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
             for term in terms:
                 gate_acc = e_add(
                     gate_acc,
-                    e_mul(term, specialized_challenges[ch_off + term_i]),
+                    e_mul(term, ch["specialized"][ch_off + term_i]),
                 )
                 term_i += 1
         t_accumulator = e_add(t_accumulator, gate_acc)
         ch_off += g.num_terms * reps
         var_off += vw * reps
         const_off += 0 if share else cw * reps
-    assert ch_off == total_spec_terms
+    assert ch_off == counts["total_spec_terms"]
 
     # general purpose gates
     ch_off = 0
@@ -541,23 +531,25 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
             assert len(terms) == g.num_terms, _name
             for term in terms:
                 gate_acc = e_add(
-                    gate_acc, e_mul(term, general_challenges[ch_off + term_i])
+                    gate_acc, e_mul(term, ch["general"][ch_off + term_i])
                 )
                 term_i += 1
         # destination.advance(): accumulator *= selector, once per gate
         t_accumulator = e_add(t_accumulator, e_mul(gate_acc, selector))
         ch_off += g.num_terms * reps
-    assert ch_off == total_gp_terms
+    assert ch_off == counts["total_gp_terms"]
 
     # copy permutation
+    beta = ch["beta"]
+    gamma = ch["gamma"]
     non_residues = non_residues_for_copy_permutation(
-        vk.domain_size, num_variable_polys
+        vk.domain_size, counts["num_variable_polys"]
     )
-    z_in_domain_size = e_pow(z, vk.domain_size)
+    z_in_domain_size = e_pow(point, vk.domain_size)
     vanishing_at_z = e_sub(z_in_domain_size, ONE)
-    ch_it = iter(remaining_challenges)
+    ch_it = iter(ch["remaining"])
     # z(1) == 1 via unnormalized L1
-    unnorm_l1_inv_at_z = e_mul(vanishing_at_z, e_inv(e_sub(z, ONE)))
+    unnorm_l1_inv_at_z = e_mul(vanishing_at_z, e_inv(e_sub(point, ONE)))
     contribution = e_sub(copy_permutation_z_at_z, ONE)
     contribution = e_mul(contribution, unnorm_l1_inv_at_z)
     contribution = e_mul(contribution, next(ch_it))
@@ -571,7 +563,7 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
     def chunks(seq, k):
         return [seq[i : i + k] for i in range(0, len(seq), k)]
 
-    for lhs, rhs, ch, nr_chunk, var_chunk, sigma_chunk in zip(
+    for lhs, rhs, chal, nr_chunk, var_chunk, sigma_chunk in zip(
         lhs_seq,
         rhs_seq,
         ch_it,
@@ -587,14 +579,139 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
             lhs_acc = e_mul(lhs_acc, subres)
         rhs_acc = rhs
         for non_res, variable in zip(nr_chunk, var_chunk):
-            subres = e_mul_base(z, non_res)
+            subres = e_mul_base(point, non_res)
             subres = e_mul(subres, beta)
             subres = e_add(subres, variable)
             subres = e_add(subres, gamma)
             rhs_acc = e_mul(rhs_acc, subres)
-        contribution = e_mul(e_sub(lhs_acc, rhs_acc), ch)
+        contribution = e_mul(e_sub(lhs_acc, rhs_acc), chal)
         t_accumulator = e_add(t_accumulator, contribution)
+    return t_accumulator
 
+
+def _verify_impl(vk, proof, config, check_quotient_identity):
+    if config is None:
+        config = era_main_vm_verifier_config()
+
+    lp = vk.lookup_parameters
+    pc = proof.proof_config
+    if vk.cap_size != pc["merkle_tree_cap_size"]:
+        return False
+    if vk.fri_lde_factor != pc["fri_lde_factor"]:
+        return False
+    if vk.cap_size != len(vk.setup_merkle_tree_cap):
+        return False
+    if len(proof.public_inputs) != len(vk.public_inputs_locations):
+        return False
+
+    t = ReferenceTranscript()
+    t.witness_merkle_tree_cap(vk.setup_merkle_tree_cap)
+    public_inputs_with_values = []
+    for (column, row), value in zip(
+        vk.public_inputs_locations, proof.public_inputs
+    ):
+        public_inputs_with_values.append((column, row, value))
+        t.witness_field_elements([value])
+    if vk.cap_size != len(proof.witness_oracle_cap):
+        return False
+    t.witness_merkle_tree_cap(proof.witness_oracle_cap)
+    beta = (t.get_challenge(), t.get_challenge())
+    gamma = (t.get_challenge(), t.get_challenge())
+    if lp.is_lookup:
+        lookup_beta = (t.get_challenge(), t.get_challenge())
+        lookup_gamma = (t.get_challenge(), t.get_challenge())
+    if vk.cap_size != len(proof.stage_2_oracle_cap):
+        return False
+    t.witness_merkle_tree_cap(proof.stage_2_oracle_cap)
+    alpha = (t.get_challenge(), t.get_challenge())
+
+    counts = derive_counts(vk, config)
+    num_lookup_subarguments = counts["num_lookup_subarguments"]
+    num_multiplicities_polys = counts["num_multiplicities_polys"]
+    total_num_lookup_argument_terms = counts[
+        "total_num_lookup_argument_terms"
+    ]
+    num_variable_polys = counts["num_variable_polys"]
+    num_witness_polys = counts["num_witness_polys"]
+    num_constant_polys = counts["num_constant_polys"]
+    num_copy_permutation_polys = counts["num_copy_permutation_polys"]
+    num_intermediate = counts["num_intermediate"]
+    quotient_degree = counts["quotient_degree"]
+    alpha_partitions = split_alpha_powers(alpha, counts)
+
+    if vk.cap_size != len(proof.quotient_oracle_cap):
+        return False
+    t.witness_merkle_tree_cap(proof.quotient_oracle_cap)
+    z = (t.get_challenge(), t.get_challenge())
+    for v in proof.values_at_z:
+        t.witness_field_elements(v)
+    for v in proof.values_at_z_omega:
+        t.witness_field_elements(v)
+    for v in proof.values_at_0:
+        t.witness_field_elements(v)
+
+    omega = gl.omega(vk.domain_size.bit_length() - 1)
+    # public input opening tuples grouped by opening point
+    public_input_opening_tuples = []
+    for column, row, value in public_inputs_with_values:
+        open_at = gl.pow_(omega, row)
+        for el in public_input_opening_tuples:
+            if el[0] == open_at:
+                el[1].append((column, value))
+                break
+        else:
+            public_input_opening_tuples.append([open_at, [(column, value)]])
+
+    if len(proof.values_at_z) != counts["num_poly_values_at_z"]:
+        return False
+    if len(proof.values_at_z_omega) != 1:
+        return False
+    if len(proof.values_at_0) != total_num_lookup_argument_terms:
+        return False
+
+    # ---- quotient identity at z ------------------------------------------
+    it = iter(proof.values_at_z)
+
+    def take(n):
+        return [next(it) for _ in range(n)]
+
+    opened = {
+        "variables": take(num_variable_polys),
+        "witness": take(num_witness_polys),
+        "constants": take(num_constant_polys),
+        "sigmas": take(num_copy_permutation_polys),
+        "copy_z": take(1)[0],
+        "intermediates": take(num_intermediate),
+        "multiplicities": take(num_multiplicities_polys),
+        "lookup_a": take(num_lookup_subarguments),
+        "mult_encoding": take(num_multiplicities_polys),
+        "tables": take((lp.width + 1) if lp.is_lookup else 0),
+        "copy_z_shifted": proof.values_at_z_omega[0],
+    }
+    quotient_chunks = list(it)
+    assert len(quotient_chunks) == quotient_degree
+
+    if lp.is_lookup:
+        # sumcheck: sum A_i(0) == sum B(0)
+        a_sum = ZERO
+        for v in proof.values_at_0[:num_lookup_subarguments]:
+            a_sum = e_add(a_sum, v)
+        b_sum = ZERO
+        for v in proof.values_at_0[num_lookup_subarguments:]:
+            b_sum = e_add(b_sum, v)
+        if a_sum != b_sum:
+            return False
+
+    challenges = dict(alpha_partitions)
+    challenges["beta"] = beta
+    challenges["gamma"] = gamma
+    if lp.is_lookup:
+        challenges["lookup_beta"] = lookup_beta
+        challenges["lookup_gamma"] = lookup_gamma
+    t_accumulator = t_accumulator_at(z, opened, challenges, vk, config, counts)
+
+    z_in_domain_size = e_pow(z, vk.domain_size)
+    vanishing_at_z = e_sub(z_in_domain_size, ONE)
     t_from_chunks = ZERO
     pow_acc = ONE
     for el in quotient_chunks:
@@ -675,12 +792,7 @@ def _verify_impl(vk, proof, config, check_quotient_identity):
     t.witness_field_elements(proof.final_fri_monomials[1])
 
     if new_pow_bits != 0:
-        # reference verifier.rs:1960: 256/CHAR_BITS = 4 challenges, plus one
-        # because 4 % CHAR_BITS != 0 (a quirk kept for byte parity)
-        num_chal = 256 // 64
-        if num_chal % 64 != 0:
-            num_chal += 1
-        challenges = t.get_multiple_challenges(num_chal)
+        challenges = pow_seed_challenges(t)
         # Blake2s PoW runner semantics (pow.rs:8,93): seed = challenges as
         # LE bytes; digest's first LE u64 needs pow_bits trailing zeros
         import hashlib

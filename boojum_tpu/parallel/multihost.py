@@ -63,6 +63,11 @@ def _multihost_env_detected() -> bool:
         if var in ("SLURM_JOB_NUM_NODES", "OMPI_COMM_WORLD_SIZE"):
             if v.isdigit() and int(v) > 1:
                 return True
+        elif var == "TPU_WORKER_HOSTNAMES":
+            # a single hostname (e.g. 'localhost' from single-host TPU
+            # plumbing) is not a multi-host launch
+            if len([h for h in v.split(",") if h.strip()]) > 1:
+                return True
         elif v:
             return True
     return False

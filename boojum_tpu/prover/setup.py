@@ -60,13 +60,19 @@ def non_residues_for_copy_permutation(num_cols: int) -> list[int]:
     return out
 
 
-def compute_sigma_values(copy_placement: np.ndarray, trace_len: int):
+def compute_sigma_values(
+    copy_placement: np.ndarray, trace_len: int, non_residues=None
+):
     """Vectorized permutation-polynomial construction.
 
     copy_placement: (C, n) int64 of place ids (-1 vacant). Cells holding the
     same variable form a cycle; sigma maps each cell to the next one in its
     cycle (vacant cells are fixed points). Returns (C, n) uint64 of
     sigma_col(w^row) = k_{col'} * w^{row'}.
+
+    non_residues: per-column coset representatives k_col; defaults to this
+    framework's g^col family (the reference-dialect prover passes the
+    reference's small-QNR family instead).
     """
     C, n = copy_placement.shape
     assert n == trace_len
@@ -96,7 +102,9 @@ def compute_sigma_values(copy_placement: np.ndarray, trace_len: int):
     for i in range(n):
         w_pows[i] = cur
         cur = gl.mul(cur, omega)
-    ks = np.array(non_residues_for_copy_permutation(C), dtype=np.uint64)
+    if non_residues is None:
+        non_residues = non_residues_for_copy_permutation(C)
+    ks = np.array([int(k) for k in non_residues], dtype=np.uint64)
     tgt_col = (sigma_cell // n).astype(np.int64)
     tgt_row = (sigma_cell % n).astype(np.int64)
     vals = _np_mod_mul(ks[tgt_col], w_pows[tgt_row])
