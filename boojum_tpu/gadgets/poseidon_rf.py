@@ -1,0 +1,66 @@
+"""Legacy-Poseidon circuit round function + sponge gadget.
+
+Counterpart of `/root/reference/src/gadgets/poseidon/mod.rs` (the circuit
+round function delegating to the legacy flattened gate,
+`src/cs/gates/poseidon.rs:1249`) and the generic algebraic sponge
+(`/root/reference/src/algebraic_props/sponge.rs`) instantiated over circuit
+variables: rate 8 / capacity 4 / overwrite mode, bit-compatible with the
+host legacy permutation (`boojum_tpu.hashes.poseidon`) — a recursion circuit
+using this sponge recomputes exactly the challenges a
+`ProofConfig(transcript="poseidon")` prover drew.
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.poseidon_flat import SW, PoseidonFlattenedGate
+
+RATE = 8
+CAPACITY = 4
+
+
+def circuit_permutation(cs, state_vars):
+    """One width-12 legacy-Poseidon permutation over circuit variables (one
+    flattened-gate instance)."""
+    return PoseidonFlattenedGate.permutation(cs, state_vars)
+
+
+class CircuitPoseidonSponge:
+    """Overwrite-mode sponge over circuit variables (reference
+    sponge.rs:172 generic sponge instantiated with the legacy round
+    function; absorb order matches the host `PoseidonSpongeHost`)."""
+
+    def __init__(self, cs):
+        self.cs = cs
+        zero = cs.zero_var()
+        self.state = [zero] * SW
+        self.buffer: list = []
+
+    def absorb(self, variables):
+        self.buffer.extend(variables)
+        while len(self.buffer) >= RATE:
+            chunk, self.buffer = self.buffer[:RATE], self.buffer[RATE:]
+            self.state = circuit_permutation(
+                self.cs, chunk + self.state[RATE:]
+            )
+
+    def finalize(self, n=CAPACITY):
+        if self.buffer:
+            zero = self.cs.zero_var()
+            pad = [zero] * (RATE - len(self.buffer))
+            self.state = circuit_permutation(
+                self.cs, self.buffer + pad + self.state[RATE:]
+            )
+            self.buffer = []
+        return self.state[:n]
+
+
+def circuit_hash_leaf(cs, variables, n=CAPACITY):
+    sp = CircuitPoseidonSponge(cs)
+    sp.absorb(list(variables))
+    return sp.finalize(n)
+
+
+def circuit_hash_node(cs, left, right):
+    sp = CircuitPoseidonSponge(cs)
+    sp.absorb(list(left) + list(right))
+    return sp.finalize(CAPACITY)

@@ -21,8 +21,15 @@ from ..poseidon2_rf import circuit_permutation
 
 
 class CircuitTranscript:
-    def __init__(self, cs):
+    def __init__(self, cs, permutation=None):
+        """`permutation` selects the in-circuit round function — the
+        Poseidon2 flattened gate by default, or the legacy-Poseidon one
+        (`gadgets.poseidon_rf.circuit_permutation`) for proofs drawn with
+        `ProofConfig(transcript="poseidon")` (reference
+        recursive_transcript.rs is generic over the round function the same
+        way)."""
         self.cs = cs
+        self._perm = permutation or circuit_permutation
         zero = cs.zero_var()
         self.state = [zero] * 12
         self.buffer: list = []
@@ -39,7 +46,7 @@ class CircuitTranscript:
         if not self.buffer:
             if self.available:
                 return self.available.pop(0)
-            self.state = circuit_permutation(self.cs, self.state)
+            self.state = self._perm(self.cs, self.state)
             self.available = list(self.state[:8])
             return self.available.pop(0)
         to_absorb = self.buffer + [self.cs.one_var()]
@@ -48,7 +55,7 @@ class CircuitTranscript:
         while len(to_absorb) % 8 != 0:
             to_absorb.append(zero)
         for i in range(0, len(to_absorb), 8):
-            self.state = circuit_permutation(
+            self.state = self._perm(
                 self.cs, to_absorb[i : i + 8] + self.state[8:]
             )
         self.available = list(self.state[:8])

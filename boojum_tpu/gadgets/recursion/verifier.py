@@ -124,10 +124,17 @@ def recursive_verify(cs, vk, proof, gates):
     W = vk.num_wit_cols
     lp = vk.lookup_params
     lookups = lp is not None and lp.is_enabled
-    assert getattr(vk, "transcript", "poseidon2") == "poseidon2", (
-        "the in-circuit verifier replays the Poseidon2 transcript only "
-        "(the reference's recursion-compatible transcript configuration)"
+    transcript_kind = getattr(vk, "transcript", "poseidon2")
+    assert transcript_kind in ("poseidon2", "poseidon"), (
+        "the in-circuit verifier replays algebraic transcripts only "
+        "(Poseidon2 or legacy Poseidon — byte transcripts are not "
+        "circuit-replayable, matching the reference's recursion-compatible "
+        "configurations)"
     )
+    if transcript_kind == "poseidon":
+        from ..poseidon_rf import circuit_permutation as transcript_perm
+    else:
+        from ..poseidon2_rf import circuit_permutation as transcript_perm
     lk_specialized = lookups and lp.use_specialized_columns
     M = 1 if lookups else 0
     wdt = lp.width if lookups else 0
@@ -150,7 +157,7 @@ def recursive_verify(cs, vk, proof, gates):
     assert len(proof.values_at_0) == R + M
 
     # ---- transcript replay ------------------------------------------------
-    t = CircuitTranscript(cs)
+    t = CircuitTranscript(cs, permutation=transcript_perm)
     t.witness_merkle_tree_cap(avk.setup_merkle_cap)
     t.witness_field_elements(ap.public_inputs)
     t.witness_merkle_tree_cap(ap.witness_cap)

@@ -1,4 +1,4 @@
-"""NTT on the MXU: four-step decomposition as exact bf16 limb matmuls.
+"""NTT on the MXU: four-step decomposition as exact int8 digit matmuls.
 
 TPU-native counterpart of the reference's vectorized NTT tier
 (`/root/reference/src/fft/mod.rs:852,1088` + the AVX-512/NEON MixedGL
@@ -24,12 +24,19 @@ with
 Both conventions come out so the row-major flattening of the result IS the
 bit-reversed (resp. natural) order — no transposes anywhere.
 
-Exact integer matmul on the MXU: every Goldilocks operand splits into eight
-8-bit limbs. Limbs (<= 255) are exactly representable in bfloat16, and a
-256-term dot of 8-bit limb products stays under 2^24, so the MXU's native
-bf16 x bf16 -> f32 accumulation is EXACT. The 64 per-(limb,limb) products are
-accumulated into 15 diagonal planes in int32 on the VPU, then folded mod p
-with 2^64 = eps = 2^32 - 1, 2^96 = -1, 2^128 = -2^32 (mod p).
+Exact integer matmul on the MXU: every Goldilocks operand is written in
+BALANCED base-256 — eight signed digits d_k in [-128, 127] — and the 64
+per-(digit,digit) products run as int8 x int8 -> int32 dots, the MXU's
+native (and fastest: 2x bf16 on v5e) integer mode, with exact int32
+accumulation at any contraction length used here. Representability: the
+8-digit balanced range is [-0x8080808080808080, 0x7F7F7F7F7F7F7F7F] (=: [m,
+M], every byte -128 resp. +127), and p + m < M, so for every canonical x
+either x itself (x <= M) or x - p (two's complement) has an exact form —
+the in-kernel conversion is one conditional `+= 2^32-1` (== -p mod 2^64)
+plus a byte-wise carry chain. The 64 product planes are accumulated into 15
+signed diagonal planes on the VPU, biased non-negative, then folded mod p
+with 2^64 = eps = 2^32 - 1, 2^96 = -1, 2^128 = -2^32 (mod p), and the
+constant bias contribution is subtracted at the end.
 
 Sizes 2^14..2^16 run as single fused kernels; 2^17..2^22 run the leading
 (resp. trailing) radix-2 stages in XLA and drop bit-exactly into per-block
@@ -65,6 +72,20 @@ _P_LO = np.uint32(1)
 _P_HI = np.uint32(0xFFFFFFFF)
 _FULL = np.uint32(0xFFFFFFFF)
 
+# Largest value representable in 8 balanced base-256 digits: 127 per byte.
+# For canonical x > _M_BAL the kernel switches to the x - p representative
+# (p + (minimum representable) < _M_BAL, so one switch always suffices).
+_M_BAL = 0x7F7F7F7F7F7F7F7F
+_M_WORD = np.uint32(0x7F7F7F7F)
+# Diagonal bias making the signed diagonal planes non-negative before the
+# unsigned fold: |Q_k| <= 8 pairs * 256 terms * 128*128 = 2^25.
+_BIAS = np.int32(1 << 25)
+_BIAS_TOTAL = sum((1 << 25) << (8 * k) for k in range(15)) % gl.P
+_BIAS_PAIR = (
+    np.uint32(_BIAS_TOTAL & 0xFFFFFFFF),
+    np.uint32(_BIAS_TOTAL >> 32),
+)
+
 _COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
 
 
@@ -78,13 +99,20 @@ def _pow_table(base: int, count: int) -> np.ndarray:
     return np.array(gl.powers(base, count), dtype=np.uint64)
 
 
-def _limbs8_np(x: np.ndarray):
-    """(.., ..) u64 -> (8, ..) bf16 planes of 8-bit limbs."""
-    planes = [
-        ((x >> np.uint64(8 * j)) & np.uint64(0xFF)).astype(np.float32)
-        for j in range(8)
-    ]
-    return jnp.asarray(np.stack(planes), dtype=jnp.bfloat16)
+def _digits8_np(x: np.ndarray):
+    """u64 canonical -> (8, ..) int8 planes of balanced base-256 digits."""
+    x = np.asarray(x, dtype=np.uint64)
+    # x - p mod 2^64 == x + (2^32 - 1); numpy wraps mod 2^64
+    u = np.where(x > np.uint64(_M_BAL), x + np.uint64(0xFFFFFFFF), x)
+    digs = []
+    carry = np.zeros(x.shape, dtype=np.int64)
+    for k in range(8):
+        b = ((u >> np.uint64(8 * k)) & np.uint64(0xFF)).astype(np.int64)
+        t = b + carry
+        ge = t >= 128
+        digs.append((t - 256 * ge).astype(np.int8))
+        carry = ge.astype(np.int64)
+    return jnp.asarray(np.stack(digs))
 
 
 def _pair_np(x: np.ndarray):
@@ -131,12 +159,12 @@ class MXUNTTContext:
         F = powsRi_scaled[(r_idx[:, None] * brR[None, :]) % R]  # (R, R)
 
         with jax.ensure_compile_time_eval():
-            self.dr = _limbs8_np(D_R)  # (8, R, R)
-            self.dct = _limbs8_np(D_C.T.copy())  # (8, C, C)
+            self.dr = _digits8_np(D_R)  # (8, R, R)
+            self.dct = _digits8_np(D_C.T.copy())  # (8, C, C)
             self.tw = _pair_np(T)
-            self.einv = _limbs8_np(E_inv)
+            self.einv = _digits8_np(E_inv)
             self.tw_inv = _pair_np(T_inv)
-            self.f = _limbs8_np(F)
+            self.f = _digits8_np(F)
 
 
 @lru_cache(maxsize=None)
@@ -145,21 +173,26 @@ def get_mxu_ctx(log_n: int) -> MXUNTTContext:
 
 
 # ---------------------------------------------------------------------------
-# In-kernel exact GL matmul: bf16 limb dots + int32 diagonals + mod-p fold
+# In-kernel exact GL matmul: int8 digit dots + int32 diagonals + mod-p fold
 # ---------------------------------------------------------------------------
 
 
-def _limb_planes(x):
-    """(lo, hi) u32 pair -> list of 8 bf16 8-bit-limb planes."""
+def _digit_planes(x):
+    """(lo, hi) u32 pair (canonical) -> list of 8 int8 balanced-digit planes."""
+    lo, hi = x
+    gt = ((hi > _M_WORD) | ((hi == _M_WORD) & (lo > _M_WORD))).astype(_u32)
+    # x + (2^32 - 1) where x > M  (== x - p mod 2^64, two's complement)
+    lo2 = lo - gt
+    hi2 = hi + (gt & (lo != 0).astype(_u32))
     planes = []
-    for w in x:
+    carry = jnp.zeros_like(lo, dtype=jnp.int32)
+    for w in (lo2, hi2):
         for j in range(4):
             b = (w >> np.uint32(8 * j)) & _MASK8 if j else w & _MASK8
-            # Mosaic has no u32->f32 cast; limbs are < 256 so going through
-            # int32 is exact
-            planes.append(
-                b.astype(jnp.int32).astype(jnp.float32).astype(jnp.bfloat16)
-            )
+            t = b.astype(jnp.int32) + carry
+            ge = (t >= 128).astype(jnp.int32)
+            planes.append((t - 256 * ge).astype(jnp.int8))
+            carry = ge
     return planes
 
 
@@ -206,6 +239,20 @@ def _p_minus_hi(v):
     return jnp.full_like(v, _P_LO), _P_HI - v
 
 
+def _fold15_signed(Q):
+    """15 SIGNED int32 diagonal planes (|Q_k| <= 2^25) -> canonical GL pair.
+
+    Bias each plane non-negative, run the unsigned fold, subtract the baked
+    bias total mod p."""
+    Qb = [(q + _BIAS).astype(_u32) for q in Q]
+    acc = _fold15(Qb)
+    bias = (
+        jnp.full_like(acc[0], _BIAS_PAIR[0]),
+        jnp.full_like(acc[1], _BIAS_PAIR[1]),
+    )
+    return limbs.sub(acc, bias)
+
+
 def _fold15(Q):
     """15 int32 diagonal planes (Q_k < 2^31) -> canonical GL (lo, hi) pair.
 
@@ -248,24 +295,23 @@ def _fold15(Q):
 
 
 def _gl_matmul(x, dref, side: str):
-    """Exact GL matmul of data pair `x` against baked limb planes `dref`.
+    """Exact GL matmul of data pair `x` against baked int8 digit planes.
 
     side='left':  result = D @ X   (contract over X's rows)
     side='right': result = X @ D   (contract over X's cols)
     """
-    planes = _limb_planes(x)
+    planes = _digit_planes(x)
     Q = [None] * 15
     for u in range(8):
         du = dref[u]
         for v in range(8):
             if side == "left":
-                p = jnp.dot(du, planes[v], preferred_element_type=jnp.float32)
+                p = jnp.dot(du, planes[v], preferred_element_type=jnp.int32)
             else:
-                p = jnp.dot(planes[v], du, preferred_element_type=jnp.float32)
-            pi = p.astype(jnp.int32)
+                p = jnp.dot(planes[v], du, preferred_element_type=jnp.int32)
             k = u + v
-            Q[k] = pi if Q[k] is None else Q[k] + pi
-    return _fold15(Q)
+            Q[k] = p if Q[k] is None else Q[k] + p
+    return _fold15_signed(Q)
 
 
 # ---------------------------------------------------------------------------

@@ -38,24 +38,22 @@ planes = limbs.split(jnp.asarray(a.reshape(B, R, C)))
 def _dots_kernel(mode, dr, dct, tlo, thi, xl, xh, ol, oh):
     x = (xl[0], xh[0])
     if mode == "dots":
-        pl_ = M._limb_planes(x)
+        pl_ = M._digit_planes(x)
         acc = None
         for u in range(8):
             for v in range(8):
-                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.float32)
-                pi = p.astype(jnp.int32)
-                acc = pi if acc is None else acc + pi
+                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.int32)
+                acc = p if acc is None else acc + p
         ol[0] = acc.astype(jnp.uint32)
         oh[0] = acc.astype(jnp.uint32)
     elif mode == "diag":
-        pl_ = M._limb_planes(x)
+        pl_ = M._digit_planes(x)
         Q = [None] * 15
         for u in range(8):
             for v in range(8):
-                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.float32)
-                pi = p.astype(jnp.int32)
+                p = jnp.dot(dr[u], pl_[v], preferred_element_type=jnp.int32)
                 k = u + v
-                Q[k] = pi if Q[k] is None else Q[k] + pi
+                Q[k] = p if Q[k] is None else Q[k] + p
         acc = Q[0]
         for k in range(1, 15):
             acc = acc + Q[k]
@@ -72,13 +70,10 @@ def _dots_kernel(mode, dr, dct, tlo, thi, xl, xh, ol, oh):
         ol[0] = z[0]
         oh[0] = z[1]
     elif mode == "fold":
-        # extraction + fold cost without matmuls: fake diagonals from limbs
-        pl_ = M._limb_planes(x)
-        Q = [
-            (pl_[k % 8].astype(jnp.float32) * 7.0).astype(jnp.int32)
-            for k in range(15)
-        ]
-        y = M._fold15(Q)
+        # extraction + fold cost without matmuls: fake diagonals from digits
+        pl_ = M._digit_planes(x)
+        Q = [pl_[k % 8].astype(jnp.int32) * 7 for k in range(15)]
+        y = M._fold15_signed(Q)
         ol[0] = y[0]
         oh[0] = y[1]
     elif mode == "twiddle":

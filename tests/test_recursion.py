@@ -178,3 +178,38 @@ def test_recursive_verifier_general_lookup_mode():
     outer2 = ConstraintSystem(RECURSION_GEOM, 1 << 15)
     recursive_verify(outer2, setup.vk, bad, asm.gates)
     assert not check_if_satisfied(outer2.into_assembly())
+
+
+def test_recursive_verifier_legacy_poseidon_transcript():
+    """Legacy-recursion-mode transcript (reference recursive_transcript.rs is
+    generic over the round function; the legacy mode drives it with
+    PoseidonFlattenedGate): an inner proof drawn with
+    ProofConfig(transcript="poseidon") replays in-circuit through the
+    legacy-Poseidon sponge gadget. Satisfiable on the honest proof;
+    unsatisfiable on a tampered public input (which shifts every legacy
+    transcript challenge)."""
+    cfg = ProofConfig(
+        fri_lde_factor=8,
+        merkle_tree_cap_size=4,
+        num_queries=8,
+        pow_bits=0,
+        fri_final_degree=4,
+        transcript="poseidon",
+    )
+    cs, _ = build_fibonacci_circuit(steps=20)
+    asm = cs.into_assembly()
+    setup = generate_setup(asm, cfg)
+    assert setup.vk.transcript == "poseidon"
+    proof = prove(asm, setup, cfg)
+    assert verify(setup.vk, proof, asm.gates)
+
+    outer = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    pi_vars, _cap = recursive_verify(outer, setup.vk, proof, asm.gates)
+    assert [outer.get_value(v) for v in pi_vars] == list(proof.public_inputs)
+    assert check_if_satisfied(outer.into_assembly(), verbose=True)
+
+    bad = Proof.from_json(proof.to_json())
+    bad.public_inputs[0] = (bad.public_inputs[0] + 1) % gl.P
+    outer2 = ConstraintSystem(RECURSION_GEOM, 1 << 15)
+    recursive_verify(outer2, setup.vk, bad, asm.gates)
+    assert not check_if_satisfied(outer2.into_assembly())
