@@ -57,6 +57,48 @@ class CircuitQueue:
         decompose_and_check(cs, self.length.var, 32)
         return el
 
+    def push_with_optimizer(self, cs, element_vars, execute: Boolean,
+                            id: int, optimizer):
+        """Conditional push whose chaining permutation is shared through a
+        SpongeOptimizer (reference mod.rs:277 push_with_optimizer): the new
+        tail/length only take effect under `execute`, and the hash rounds
+        become optimizer requests instead of dedicated permutations."""
+        from .queue_optimizer import variable_length_hash_with_optimizer
+
+        assert len(element_vars) == self.element_width
+        new_tail = variable_length_hash_with_optimizer(
+            cs, list(element_vars) + self.tail, id, execute, optimizer
+        )
+        self.tail = [
+            Num.select(cs, execute, Num(a), Num(b)).var
+            for a, b in zip(new_tail, self.tail)
+        ]
+        incremented = self.length.add_constant(cs, 1)
+        self.length = Num.select(cs, execute, incremented, self.length)
+        if execute.get_value(cs):
+            self._witness.append([cs.get_value(v) for v in element_vars])
+
+    def pop_with_optimizer(self, cs, execute: Boolean, id: int, optimizer):
+        """Conditional pop through the optimizer (reference mod.rs:420)."""
+        from .queue_optimizer import variable_length_hash_with_optimizer
+
+        if execute.get_value(cs):
+            values = self._witness.popleft()
+        else:
+            values = [0] * self.element_width
+        el = [cs.alloc_variable_with_value(v) for v in values]
+        new_head = variable_length_hash_with_optimizer(
+            cs, el + self.head, id, execute, optimizer
+        )
+        self.head = [
+            Num.select(cs, execute, Num(a), Num(b)).var
+            for a, b in zip(new_head, self.head)
+        ]
+        decremented = self.length.add_constant(cs, gl.P - 1)
+        self.length = Num.select(cs, execute, decremented, self.length)
+        decompose_and_check(cs, self.length.var, 32)
+        return el
+
     def is_empty(self, cs) -> Boolean:
         return self.length.is_zero(cs)
 

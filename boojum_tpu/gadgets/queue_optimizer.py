@@ -1,0 +1,140 @@
+"""Sponge round batching across mutually-exclusive queue operations.
+
+Counterpart of `/root/reference/src/gadgets/queue/queue_optimizer/`
+(`sponge_optimizer.rs`, `mod.rs`): circuits that in any given execution step
+perform AT MOST ONE of N possible queue operations (the Era main VM's
+opcode-dispatched queues) would otherwise pay N permutations per step — one
+per possible operation, all but one gated off. The optimizer batches them:
+each operation registers a *request* `(initial_state, claimed_final_state,
+applies)` under its stream id, and `enforce()` lays down ONE real permutation
+per request slot, selecting among the per-slot requests by their (provably
+at-most-one-hot) `applies` flags and conditionally enforcing the selected
+claimed final state.
+
+The claimed final states are witness-allocated by the absorb helper (zeros
+when the operation does not execute), so non-executing branches cost only
+selects — the permutation constraints are shared.
+"""
+
+from __future__ import annotations
+
+from ..cs.gates.simple import FmaGate, ReductionGate
+from ..field import gl
+from ..hashes.poseidon2 import poseidon2_permutation_host
+from .boolean import Boolean
+from .num import Num
+from .poseidon2_rf import RATE, SW, circuit_permutation
+
+T_COMMIT = 4
+
+
+class SpongeOptimizer:
+    """Batches sponge-round requests from `num_ids` mutually exclusive
+    request streams into at most `capacity` real permutations (reference
+    sponge_optimizer.rs `SpongeOptimizer`)."""
+
+    def __init__(self, cs, capacity: int, num_ids: int):
+        self.cs = cs
+        self.capacity = capacity
+        self.num_ids = num_ids
+        self.requests: list[list] = [[] for _ in range(num_ids)]
+
+    def add_request(self, initial_state, claimed_final_state,
+                    applies: Boolean, id: int):
+        assert len(initial_state) == SW and len(claimed_final_state) == SW
+        stream = self.requests[id]
+        assert len(stream) < self.capacity, (
+            f"over capacity: capacity is {self.capacity} but stream {id} "
+            f"already has {len(stream)} requests"
+        )
+        stream.append((list(initial_state), list(claimed_final_state), applies))
+
+    def is_fresh(self) -> bool:
+        return all(not s for s in self.requests)
+
+    def enforce(self):
+        """One permutation per request slot; per-slot requests are selected
+        by their applies flags (enforced at-most-one-hot) and the selected
+        claimed state is conditionally enforced."""
+        cs = self.cs
+        zero = cs.zero_var()
+        for slot in range(self.capacity):
+            per_slot = [s[slot] for s in self.requests if slot < len(s)]
+            if not per_slot:
+                continue
+            if len(per_slot) == 1:
+                init, claimed, applies = per_slot[0]
+            else:
+                # at-most-one-hot: the sum of flags must itself be boolean —
+                # and that checked sum IS the OR of the flags, so it doubles
+                # as the combined applies flag for free
+                flags = [r[2] for r in per_slot]
+                bit_sum = flags[0].var
+                for f in flags[1:]:
+                    bit_sum = ReductionGate.reduce(
+                        cs, [bit_sum, f.var, zero, zero], [1, 1, 0, 0]
+                    )
+                applies = Boolean.from_variable_checked(cs, bit_sum)
+                init, claimed, _ = per_slot[0]
+                for nxt_init, nxt_claimed, flag in per_slot[1:]:
+                    init = [
+                        Num.select(cs, flag, Num(a), Num(b)).var
+                        for a, b in zip(nxt_init, init)
+                    ]
+                    claimed = [
+                        Num.select(cs, flag, Num(a), Num(b)).var
+                        for a, b in zip(nxt_claimed, claimed)
+                    ]
+            result = circuit_permutation(cs, init)
+            for res, want in zip(result, claimed):
+                diff = FmaGate.fma(cs, cs.one_var(), want, res, gl.P - 1, 1)
+                FmaGate.enforce_fma(cs, applies.var, diff, zero, zero, 1, 0)
+        for s in self.requests:
+            s.clear()
+
+
+def absorb_into_state_with_optimizer(cs, input_vars, into_state, id: int,
+                                     execute: Boolean, optimizer):
+    """Overwrite-mode absorption of `input_vars` into `into_state` whose
+    permutations go through the optimizer (reference mod.rs
+    `variable_length_absorb_into_state_using_optimizer`): intermediate
+    states are witness-allocated (zeros when not executing) and each round
+    becomes one shared request."""
+    zero = cs.zero_var()
+    chunks = []
+    rem = list(input_vars)
+    while rem:
+        head, rem = rem[:RATE], rem[RATE:]
+        chunks.append(head + [zero] * (RATE - len(head)))
+    state = list(into_state)
+    for chunk in chunks:
+        outs = cs.alloc_multiple_variables_without_values(SW)
+
+        def resolve(vals):
+            st, absorbed, exe = vals[:SW], vals[SW:SW + RATE], vals[SW + RATE]
+            if exe == 0:
+                return [0] * SW
+            return poseidon2_permutation_host(
+                list(absorbed) + list(st[RATE:])
+            )
+
+        cs.set_values_with_dependencies(
+            state + chunk + [execute.var], outs, resolve
+        )
+        provably_absorbed = chunk + state[RATE:]
+        optimizer.add_request(provably_absorbed, outs, execute, id)
+        state = list(outs)
+    return state
+
+
+def variable_length_hash_with_optimizer(cs, input_vars, id: int,
+                                        execute: Boolean, optimizer,
+                                        n=T_COMMIT):
+    """Hash through the optimizer from an empty state; returns the
+    `n`-element commitment (reference mod.rs
+    `variable_length_hash_using_optimizer`)."""
+    zero = cs.zero_var()
+    state = absorb_into_state_with_optimizer(
+        cs, input_vars, [zero] * SW, id, execute, optimizer
+    )
+    return state[:n]

@@ -1,0 +1,127 @@
+"""Sponge-optimizer tests (reference queue_optimizer/sponge_optimizer.rs:
+batch the sponge rounds of mutually exclusive queue ops into shared
+permutations; at-most-one-hot applies flags; conditional enforcement)."""
+
+from boojum_tpu.cs.implementations import ConstraintSystem
+from boojum_tpu.cs.types import CSGeometry
+from boojum_tpu.gadgets.boolean import Boolean
+from boojum_tpu.gadgets.queue import CircuitQueue
+from boojum_tpu.gadgets.queue_optimizer import (
+    SpongeOptimizer,
+    variable_length_hash_with_optimizer,
+)
+from boojum_tpu.gadgets.poseidon2_rf import circuit_hash_leaf
+from boojum_tpu.prover.satisfiability import check_if_satisfied
+
+GEOM = CSGeometry(
+    num_columns_under_copy_permutation=130,
+    num_witness_columns=0,
+    num_constant_columns=8,
+    max_allowed_constraint_degree=7,
+)
+
+
+def _cs():
+    return ConstraintSystem(GEOM, 1 << 12)
+
+
+def test_optimizer_hash_matches_plain_sponge():
+    """An executing optimizer hash commits to the same digest as the plain
+    circuit sponge (the shared-permutation path is bit-compatible)."""
+    cs = _cs()
+    inputs = [cs.alloc_variable_with_value(v) for v in (3, 1, 4, 1, 5, 9, 2, 6)]
+    execute = Boolean.allocated_constant(cs, True)
+    opt = SpongeOptimizer(cs, capacity=2, num_ids=1)
+    got = variable_length_hash_with_optimizer(cs, inputs, 0, execute, opt)
+    opt.enforce()
+    assert opt.is_fresh()
+    want = circuit_hash_leaf(cs, inputs)
+    assert [cs.get_value(v) for v in got] == [cs.get_value(v) for v in want]
+    assert check_if_satisfied(cs.into_assembly(), verbose=True)
+
+
+def test_mutually_exclusive_queue_pushes_share_permutations():
+    """Two queues pushed in alternation under complementary flags: every
+    step registers one request per stream, the optimizer lays down one
+    permutation per slot, and both queues drain consistently."""
+    cs = _cs()
+    qa = CircuitQueue(cs, element_width=4)
+    qb = CircuitQueue(cs, element_width=4)
+    steps = 4
+    opt = SpongeOptimizer(cs, capacity=steps, num_ids=2)
+    for i in range(steps):
+        to_a = Boolean.allocated_constant(cs, i % 2 == 0)
+        to_b = to_a.negate(cs)
+        el = [cs.alloc_variable_with_value(10 * i + j) for j in range(4)]
+        qa.push_with_optimizer(cs, el, to_a, 0, opt)
+        qb.push_with_optimizer(cs, el, to_b, 1, opt)
+    opt.enforce()
+
+    # drain: queue A saw steps 0,2; queue B saw 1,3
+    got_a = [cs.get_value(v) for _ in range(2) for v in qa.pop_front(cs)]
+    got_b = [cs.get_value(v) for _ in range(2) for v in qb.pop_front(cs)]
+    assert got_a == [0, 1, 2, 3, 20, 21, 22, 23]
+    assert got_b == [10, 11, 12, 13, 30, 31, 32, 33]
+    qa.enforce_consistency(cs)
+    qb.enforce_consistency(cs)
+    assert check_if_satisfied(cs.into_assembly(), verbose=True)
+
+
+def test_optimizer_rejects_two_hot_flags():
+    """Two requests applying in the same slot violate the at-most-one-hot
+    bitmask constraint (reference sponge_optimizer.rs enforce): the sum of
+    flags is 2, which fails the boolean check."""
+    cs = _cs()
+    qa = CircuitQueue(cs, element_width=4)
+    qb = CircuitQueue(cs, element_width=4)
+    opt = SpongeOptimizer(cs, capacity=1, num_ids=2)
+    both = Boolean(cs.alloc_variable_with_value(1))
+    el = [cs.alloc_variable_with_value(j) for j in range(4)]
+    qa.push_with_optimizer(cs, el, both, 0, opt)
+    qb.push_with_optimizer(cs, el, both, 1, opt)
+    opt.enforce()
+    assert not check_if_satisfied(cs.into_assembly())
+
+
+def test_conditional_pop_with_optimizer():
+    """pop_with_optimizer under a false flag leaves the queue untouched;
+    under a true flag it returns the pushed element."""
+    cs = _cs()
+    q = CircuitQueue(cs, element_width=2)
+    el = [cs.alloc_variable_with_value(v) for v in (7, 8)]
+    q.push(cs, el)
+    opt = SpongeOptimizer(cs, capacity=2, num_ids=1)
+    skip = Boolean.allocated_constant(cs, False)
+    q.pop_with_optimizer(cs, skip, 0, opt)
+    assert cs.get_value(q.length.var) == 1
+    take = Boolean.allocated_constant(cs, True)
+    got = q.pop_with_optimizer(cs, take, 0, opt)
+    assert [cs.get_value(v) for v in got] == [7, 8]
+    assert cs.get_value(q.length.var) == 0
+    opt.enforce()
+    q.enforce_consistency(cs)
+    assert check_if_satisfied(cs.into_assembly(), verbose=True)
+
+
+def test_legacy_poseidon_circuit_sponge_matches_host():
+    """The legacy-Poseidon circuit sponge (gadgets/poseidon_rf.py) hashes
+    bit-identically to the host PoseidonSpongeHost, including the
+    partial-chunk zero-pad path, and the circuit is satisfiable."""
+    from boojum_tpu.gadgets.poseidon_rf import (
+        circuit_hash_leaf as legacy_hash_leaf,
+        circuit_hash_node as legacy_hash_node,
+    )
+    from boojum_tpu.hashes.poseidon import PoseidonSpongeHost
+
+    cs = _cs()
+    vals = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]  # 10 elements: one full + one padded chunk
+    ins = [cs.alloc_variable_with_value(v) for v in vals]
+    got = legacy_hash_leaf(cs, ins)
+    want = PoseidonSpongeHost.hash_leaf(vals)
+    assert [cs.get_value(v) for v in got] == list(want)
+    left = [cs.alloc_variable_with_value(v) for v in want]
+    right = [cs.alloc_variable_with_value(v) for v in (7, 7, 7, 7)]
+    got_n = legacy_hash_node(cs, left, right)
+    want_n = PoseidonSpongeHost.hash_node(list(want), [7, 7, 7, 7])
+    assert [cs.get_value(v) for v in got_n] == list(want_n)
+    assert check_if_satisfied(cs.into_assembly(), verbose=True)
