@@ -113,11 +113,30 @@ class TestPoseidon2Kernel:
 
 class TestMXUNTTKernel:
     """Bit-parity of the MXU matmul-NTT (ntt/mxu_ntt.py) vs the staged-XLA
-    path. Interpret mode executes the same exact-integer bf16/f32/i32 ops on
-    CPU, so equality here pins the kernel's arithmetic, including the 8-bit
-    limb dots and the 15-diagonal mod-p fold."""
+    path. Interpret mode executes the same exact-integer int8/i32 ops on
+    CPU, so equality here pins the kernel's arithmetic, including the
+    balanced-digit int8 dots and the biased 15-diagonal mod-p fold."""
 
     LOG_N = 14  # smallest MXU-dispatched size
+
+    def test_balanced_digits_boundaries(self):
+        """The host digit bake and the in-kernel extraction agree and
+        reconstruct x mod p for every branch of the x -> x-p switch:
+        x <= M (plain), x > M (two's-complement subtract), and the carry
+        chain's saturating bytes."""
+        from boojum_tpu.ntt.mxu_ntt import _M_BAL, _digits8_np
+
+        cases = np.array(
+            [0, 1, 127, 128, 255, 256, _M_BAL - 1, _M_BAL, _M_BAL + 1,
+             (1 << 32) - 1, 1 << 32, (1 << 63) - 1, 1 << 63,
+             gl.P - 1, gl.P - 2, gl.P - (1 << 32)],
+            dtype=np.uint64,
+        )
+        digs = np.asarray(_digits8_np(cases)).astype(np.int64)
+        for i, x in enumerate(cases):
+            v = sum(int(digs[k, i]) * (1 << (8 * k)) for k in range(8))
+            assert (v - int(x)) % gl.P == 0, hex(int(x))
+            assert all(-128 <= int(digs[k, i]) <= 127 for k in range(8))
 
     def _data(self, log_n, cols=2, seed=30):
         a = _rand((cols, 1 << log_n), seed)
