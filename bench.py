@@ -39,11 +39,18 @@ def _enable_compile_cache():
     try:
         import jax
 
-        # NOT the tests' .jax_cache: the axon remote compile service runs
-        # on a different host, and its CPU-flavored AOT entries SIGILL the
-        # local machine when the CPU test suite loads them
+        # NOT the tests' .jax_cache, and salted by the platform string: the
+        # axon remote compile service runs on a different host, and its
+        # CPU-flavored AOT entries SIGILL the local machine when a local CPU
+        # process loads them — caches from different platforms must never
+        # mix (same rule as boojum_tpu/__init__.py's default cache)
+        plat = (
+            os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
+            or "default"
+        )
         cache = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), ".jax_cache_tpu"
+            os.path.dirname(os.path.abspath(__file__)),
+            f".jax_cache_bench_{plat}",
         )
         jax.config.update("jax_compilation_cache_dir", cache)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

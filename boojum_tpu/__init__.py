@@ -23,11 +23,20 @@ jax.config.update("jax_enable_x64", True)
 if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
     try:
         if not jax.config.jax_compilation_cache_dir:
+            # one cache dir PER PLATFORM STRING: a remote-TPU process (e.g.
+            # JAX_PLATFORMS=axon) gets its host-side CPU AOT pieces compiled
+            # by the remote service with the REMOTE machine's features, and
+            # loading those entries in a local CPU process SIGILLs — the two
+            # worlds must never share a cache
+            _plat = (
+                os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
+                or "default"
+            )
             jax.config.update(
                 "jax_compilation_cache_dir",
                 os.environ.get(
                     "BOOJUM_TPU_COMPILE_CACHE",
-                    os.path.expanduser("~/.cache/boojum_tpu_xla"),
+                    os.path.expanduser(f"~/.cache/boojum_tpu_xla-{_plat}"),
                 ),
             )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

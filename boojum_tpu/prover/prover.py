@@ -224,13 +224,31 @@ def _l0_brev(log_n, lde_factor):
     )
 
 
+# input bytes per chunk of the in-graph coset evaluation: at 2^20 rows a
+# whole oracle group is 700+ MB and the transform's transient working set is
+# a small multiple of its input, which is what exhausted HBM in the round-3
+# sweep; sequential dynamic-update-slice chunks bound it
+_SWEEP_EVAL_CHUNK = 128 << 20
+
+
 @jax.jit
 def _coset_eval(mono_stack, scale_row):
     """Evaluate a (B, n) monomial stack over ONE LDE coset: the scale row is
     shift_c^i (ntt._lde_scale_cached row c), then a forward NTT. One
-    compiled graph reused for every coset of the streamed quotient sweep."""
-    scaled = gf.mul(mono_stack, scale_row[None, :])
-    return fft_natural_to_bitreversed(scaled)
+    compiled graph reused for every coset of the streamed quotient sweep.
+    Column batches are transformed in sequentially-chained chunks so the
+    peak transient stays bounded regardless of B."""
+    B, n = mono_stack.shape
+    per = max(1, _SWEEP_EVAL_CHUNK // (n * 8))
+    if B <= per:
+        scaled = gf.mul(mono_stack, scale_row[None, :])
+        return fft_natural_to_bitreversed(scaled)
+    out = jnp.zeros((B, n), jnp.uint64)
+    for i in range(0, B, per):
+        chunk = gf.mul(mono_stack[i : i + per], scale_row[None, :])
+        chunk = fft_natural_to_bitreversed(chunk)
+        out = jax.lax.dynamic_update_slice_in_dim(out, chunk, i, axis=0)
+    return out
 
 
 @lru_cache(maxsize=4)
@@ -500,11 +518,9 @@ def _coset_sweep_fn(assembly, setup, lk_ctx):
     return fn
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5))
-def _quotient_tail_fused(T0_parts, T1_parts, Q: int, n: int, L: int, cap: int):
-    """Quotient interpolation + chunk split + commit in one dispatch."""
-    from ..merkle import _tree_layers
-
+@partial(jax.jit, static_argnums=(2, 3))
+def _quotient_interp(T0_parts, T1_parts, Q: int, n: int):
+    """Quotient interpolation + chunk split (one dispatch)."""
     g_inv = gl.inv(gl.MULTIPLICATIVE_GENERATOR)
     T0 = jnp.concatenate(list(T0_parts))
     T1 = jnp.concatenate(list(T1_parts))
@@ -516,10 +532,29 @@ def _quotient_tail_fused(T0_parts, T1_parts, Q: int, n: int, L: int, cap: int):
     for i in range(Q):
         for comp in (0, 1):
             q_cols.append(T_mono[comp][i * n : (i + 1) * n])
-    q_mono = jnp.stack(q_cols)
+    return jnp.stack(q_cols)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _commit_leaf_layers(lde, cap: int):
+    from ..merkle import _tree_layers
+
+    B = lde.shape[0]
+    return _tree_layers(lde.reshape(B, -1).T, cap)
+
+
+def _quotient_tail_fused(T0_parts, T1_parts, Q: int, n: int, L: int, cap: int):
+    """Quotient interpolation + chunk split + LDE + commit.
+
+    Deliberately SEPARATE dispatches (interp / LDE / tree): at 2^20 rows
+    one fused graph's working set — the size-Q*n inverse transform, the
+    rate-L LDE, the leaf-major transpose and the tree layers with no dead-
+    buffer reuse between them — landed right at the device's memory
+    ceiling. Three extra launches cost ~30 ms; the freed intermediates are
+    GBs."""
+    q_mono = _quotient_interp(tuple(T0_parts), tuple(T1_parts), Q, n)
     q_lde = lde_from_monomial(q_mono, L)
-    B = q_lde.shape[0]
-    return q_mono, q_lde, _tree_layers(q_lde.reshape(B, -1).T, cap)
+    return q_mono, q_lde, _commit_leaf_layers(q_lde, cap)
 
 
 @jax.jit
@@ -790,6 +825,23 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             num_all, den_inv_all, lk_inv, mult_dev, consts_dev
         )
         s2_tree = _tree_from_layers(layers, cap)
+        # the chunk numerator/denominator ext stacks and lookup
+        # denominators total ~2 GB at 2^20 rows and are dead after the
+        # tail — rebind so the buffers free before the round-3 sweep
+        num_all = den_all = den_inv_all = lk_inv = dens = mult_dev = None
+        if stream:
+            # streamed proves regenerate everything from monomials; the
+            # values-form device-input caches (witness columns, sigmas,
+            # table stack — ~1.5 GB at 2^20) only save warm-rep H2D time
+            # and that residency is what the big-trace mode cannot afford
+            for _obj, _keys in (
+                (assembly, ("witness_cols", "table_stack", "mult")),
+                (setup, ("sigma",)),
+            ):
+                _c = getattr(_obj, "_dev_cache", None)
+                if _c is not None:
+                    for _k in _keys:
+                        _c.pop(_k, None)
     else:
         z, partials, chunks = compute_copy_permutation_stage2(
             copy_vals, sigma_dev, setup.non_residues, beta, gamma,
@@ -912,6 +964,22 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             tuple(mk_path) if mk_path is not None else None,
         )
         sweep = _coset_sweep_fn(assembly, setup, lk_ctx)
+        import os as _os
+
+        # At large traces each sweep execution's working set is a
+        # significant fraction of HBM; queueing all Q async lets neighbors'
+        # allocations overlap and OOM (observed at 2^20: individually-synced
+        # sweeps pass, back-to-back queueing exhausts). A barrier per coset
+        # costs Q x ~10 ms launch RTT — noise at this scale.
+        # BOOJUM_TPU_SYNC_SWEEPS=1 forces barriers at any size, =0 disables
+        # them even at large n.
+        _sv = _os.environ.get("BOOJUM_TPU_SYNC_SWEEPS", "").strip().lower()
+        if _sv in ("0", "false"):
+            _sync_sweeps = False
+        elif _sv:
+            _sync_sweeps = True
+        else:
+            _sync_sweeps = n >= (1 << 19)
         T_parts0, T_parts1 = [], []
         for c in range(Q):
             t0c, t1c = sweep(
@@ -921,6 +989,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 lkb01 if lkb01 is not None else zero2,
                 lkg01 if lkg01 is not None else zero2,
             )
+            if _sync_sweeps:
+                jax.block_until_ready(t1c)
             T_parts0.append(t0c)
             T_parts1.append(t1c)
         q_mono, q_lde, layers = _quotient_tail_fused(
