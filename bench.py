@@ -11,25 +11,43 @@ realized trace length on stderr). The timed quantity is the proving
 wall-clock with warm compile caches (the reference's "Proving is done,
 taken ..." line measures the same region).
 
+Robustness: the remote compile service behind the axon tunnel takes minutes
+per big fused graph on a cold cache (and occasionally drops a compile RPC).
+A watchdog thread guarantees the JSON line is printed within BENCH_BUDGET_S
+seconds no matter what: if the full protocol hasn't finished by then, the
+line carries whatever was measured so far plus a "status" field, and the
+process exits 0. A completed run reports status "ok".
+
 Environment knobs:
   BENCH_CIRCUIT = sha256 (default) | fma
   BENCH_SHA_BYTES = message size (default 8192)
   BENCH_LOG_N = fma-mode trace log2 size (default 10)
-  BENCH_REPS = timed repetitions (default 1)
+  BENCH_REPS = timed repetitions (default 3)
+  BENCH_BUDGET_S = hard wall-clock budget before the watchdog reports
+      (default 1500)
   BENCH_LDE = FRI commit rate override (default 8 sha / 4 fma; the
       quotient still evaluates at the degree-derived rate — BENCH_LDE=2 is
       the Era main-VM golden-proof commit rate and what 2^20-row traces
       use to stay inside HBM)
   BENCH_QUERIES = FRI query count (default 50; the reference's LDE-2
       golden proof uses 100)
+  BENCH_SKIP_NTT = 1 skips the NTT-throughput side metric
 """
 
 import json
 import os
 import sys
+import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+_T0 = time.perf_counter()
+
+
+def _log(msg):
+    print(f"[bench +{time.perf_counter() - _T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _enable_compile_cache():
@@ -60,6 +78,83 @@ def _enable_compile_cache():
 
 
 _enable_compile_cache()
+
+# ---------------------------------------------------------------------------
+# Watchdog: the driver kills the bench (rc=124, no JSON parsed) if it runs
+# past its timeout. A compile RPC stuck on the tunnel blocks the main thread
+# inside C++ where Python signals never fire, so a daemon THREAD prints the
+# best-known result and hard-exits while the main thread is still blocked.
+# ---------------------------------------------------------------------------
+
+_STATE = {
+    "metric": None,
+    "phase": "import",
+    "reps": [],           # completed timed rep walls
+    "warm_wall": None,    # warm-up (first, compile-laden) prove wall
+    "stages": {},         # per-stage split of the reported rep
+    "ntt_eps": None,
+    "done": False,
+}
+_EMIT_LOCK = threading.Lock()
+
+
+def _vs_baseline(value):
+    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
+    try:
+        base = json.load(open(base_path))
+        if base.get("metric") == _STATE["metric"] and base.get("value"):
+            return round(base["value"] / value, 3)
+    except Exception:
+        pass
+    return 1.0
+
+
+def _emit(status):
+    """Print the one JSON line (exactly once) and return it."""
+    with _EMIT_LOCK:
+        if _STATE["done"]:
+            return
+        _STATE["done"] = True
+        reps = sorted(_STATE["reps"])
+        if reps:
+            value = reps[len(reps) // 2]
+        elif _STATE["warm_wall"] is not None:
+            # no clean rep, but the protocol DID complete once (compile
+            # time included) — report that wall, flagged
+            value = _STATE["warm_wall"]
+            status = status + "+warm_only"
+        else:
+            # nothing completed: report elapsed as a lower bound
+            value = round(time.perf_counter() - _T0, 1)
+            status = status + "+no_prove"
+        out = {
+            "metric": _STATE["metric"] or "sha256_8192B_prove_wall",
+            "value": round(value, 4),
+            "unit": "s",
+            "vs_baseline": _vs_baseline(value),
+            "status": status,
+            "phase": _STATE["phase"],
+            "reps": [round(r, 4) for r in _STATE["reps"]],
+            "stages": _STATE["stages"],
+        }
+        if _STATE["ntt_eps"] is not None:
+            out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
+        print(json.dumps(out), flush=True)
+
+
+def _watchdog(budget_s):
+    deadline = _T0 + budget_s
+    while True:
+        now = time.perf_counter()
+        if _STATE["done"]:
+            return
+        if now >= deadline:
+            _log(f"watchdog fired in phase {_STATE['phase']!r}")
+            _emit("timeout")
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os._exit(0)
+        time.sleep(min(5.0, deadline - now))
 
 
 def build_sha256(num_bytes: int):
@@ -109,56 +204,9 @@ def build_fma(log_n: int):
     return cs
 
 
-def main():
-    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
-    from boojum_tpu.utils.profiling import collect_stages, stop_collecting_stages
-
-    circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
-    reps = int(os.environ.get("BENCH_REPS", "3"))
-    lde = int(
-        os.environ.get("BENCH_LDE", "8" if circuit == "sha256" else "4")
-    )
-    config = ProofConfig(
-        fri_lde_factor=lde,
-        merkle_tree_cap_size=16,
-        num_queries=int(os.environ.get("BENCH_QUERIES", "50")),
-        pow_bits=0,
-        fri_final_degree=16,
-    )
-    if circuit == "sha256":
-        num_bytes = int(os.environ.get("BENCH_SHA_BYTES", "8192"))
-        cs = build_sha256(num_bytes)
-        metric = f"sha256_{num_bytes}B_prove_wall"
-    else:
-        log_n = int(os.environ.get("BENCH_LOG_N", "10"))
-        cs = build_fma(log_n)
-        metric = f"fma_2^{log_n}_prove_wall"
-
-    asm = cs.into_assembly()
-    print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
-    setup = generate_setup(asm, config)
-
-    # warm-up (compiles) then timed runs; report the MEDIAN rep and its
-    # per-stage wall-clock split (the tunnel-attached device is noisy, so a
-    # single rep is not a number of record)
-    proof = prove(asm, setup, config)
-    assert verify(setup.vk, proof, asm.gates)
-    rep_results = []
-    for _ in range(reps):
-        sink = collect_stages()
-        t0 = time.perf_counter()
-        proof = prove(asm, setup, config)
-        rep_wall = time.perf_counter() - t0
-        rep_results.append((rep_wall, list(sink)))
-    stop_collecting_stages()
-    rep_results.sort(key=lambda r: r[0])
-    wall, stages = rep_results[len(rep_results) // 2]
-    all_walls = [round(r[0], 4) for r in rep_results]
-    stage_split = {name: round(dt, 3) for name, dt in stages}
-
-    # NTT throughput (BASELINE.md tracked metric): Goldilocks elems/s for a
-    # batched forward+inverse pair at bench scale, warm
-    ntt_eps = None
+def _measure_ntt():
+    """NTT throughput (BASELINE.md tracked metric): Goldilocks elems/s for a
+    batched forward+inverse pair at bench scale, warm."""
     try:
         import jax
         import jax.numpy as jnp
@@ -194,30 +242,99 @@ def main():
         t1 = time.perf_counter()
         jax.block_until_ready(_ntt_chain(a))
         dt = time.perf_counter() - t1
-        ntt_eps = int(2 * ntt_reps * cols * (1 << log_n) / dt)
-    except Exception:
-        pass
+        _STATE["ntt_eps"] = int(2 * ntt_reps * cols * (1 << log_n) / dt)
+    except Exception as e:
+        _log(f"ntt side metric failed: {e!r}")
 
-    base_path = os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")
-    vs = 1.0
-    if os.path.exists(base_path):
+
+def _is_transient(exc) -> bool:
+    s = repr(exc).lower()
+    return any(k in s for k in
+               ("response body", "connection", "unavailable", "deadline",
+                "internal", "tunnel", "socket", "reset"))
+
+
+def main():
+    budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
+    threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
+
+    from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
+    from boojum_tpu.utils.profiling import collect_stages, stop_collecting_stages
+
+    circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
+    reps = int(os.environ.get("BENCH_REPS", "3"))
+    lde = int(
+        os.environ.get("BENCH_LDE", "8" if circuit == "sha256" else "4")
+    )
+    config = ProofConfig(
+        fri_lde_factor=lde,
+        merkle_tree_cap_size=16,
+        num_queries=int(os.environ.get("BENCH_QUERIES", "50")),
+        pow_bits=0,
+        fri_final_degree=16,
+    )
+    _STATE["phase"] = "synthesis"
+    if circuit == "sha256":
+        num_bytes = int(os.environ.get("BENCH_SHA_BYTES", "8192"))
+        cs = build_sha256(num_bytes)
+        _STATE["metric"] = f"sha256_{num_bytes}B_prove_wall"
+    else:
+        log_n = int(os.environ.get("BENCH_LOG_N", "10"))
+        cs = build_fma(log_n)
+        _STATE["metric"] = f"fma_2^{log_n}_prove_wall"
+
+    asm = cs.into_assembly()
+    print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
+    _STATE["phase"] = "setup"
+    _log("generating setup (compiles on a cold cache)")
+    setup = generate_setup(asm, config)
+
+    # warm-up (compiles) then timed runs; report the MEDIAN rep and its
+    # per-stage wall-clock split (the tunnel-attached device is noisy, so a
+    # single rep is not a number of record)
+    _STATE["phase"] = "warmup_prove"
+    _log("warm-up prove (compiles on a cold cache)")
+    for attempt in (1, 2):
+        t0 = time.perf_counter()  # per-attempt: a failed attempt's stall
+        # must not inflate the reported warm wall
         try:
-            base = json.load(open(base_path))
-            if base.get("metric") == metric and base.get("value"):
-                vs = base["value"] / wall
-        except Exception:
-            pass
-    out = {
-        "metric": metric,
-        "value": round(wall, 4),
-        "unit": "s",
-        "vs_baseline": round(vs, 3),
-        "reps": all_walls,
-        "stages": stage_split,
-    }
-    if ntt_eps is not None:
-        out["ntt_goldilocks_elems_per_s"] = ntt_eps
-    print(json.dumps(out))
+            proof = prove(asm, setup, config)
+            break
+        except Exception as e:
+            # the tunnel occasionally drops a big compile RPC; one retry
+            # re-enters with everything already cached up to the drop
+            if attempt == 1 and _is_transient(e):
+                _log(f"warm-up prove failed transiently, retrying: {e!r}")
+                continue
+            raise
+    _STATE["warm_wall"] = round(time.perf_counter() - t0, 4)
+    _log(f"warm-up prove done in {_STATE['warm_wall']}s; verifying")
+    _STATE["phase"] = "verify"
+    assert verify(setup.vk, proof, asm.gates)
+
+    _STATE["phase"] = "timed_reps"
+    rep_stages = []
+    for i in range(reps):
+        sink = collect_stages()
+        t0 = time.perf_counter()
+        proof = prove(asm, setup, config)
+        rep_wall = time.perf_counter() - t0
+        rep_stages.append({name: round(dt, 3) for name, dt in sink})
+        # update reps + the matching median split atomically wrt the
+        # watchdog's _emit (same lock), so the reported stage split always
+        # belongs to the rep whose wall is the reported median
+        with _EMIT_LOCK:
+            _STATE["reps"].append(rep_wall)
+            order = sorted(range(len(_STATE["reps"])),
+                           key=lambda j: _STATE["reps"][j])
+            _STATE["stages"] = rep_stages[order[len(order) // 2]]
+        _log(f"rep {i + 1}/{reps}: {rep_wall:.3f}s")
+    stop_collecting_stages()
+
+    if not os.environ.get("BENCH_SKIP_NTT"):
+        _STATE["phase"] = "ntt_metric"
+        _measure_ntt()
+    _emit("ok")
 
 
 if __name__ == "__main__":

@@ -65,6 +65,66 @@ def powers(base: int, count: int) -> list:
     return out
 
 
+def mul_np(a, b):
+    """Vectorized canonical Goldilocks multiply on uint64 numpy arrays.
+
+    Schoolbook 32-bit split to the 128-bit product, then the standard
+    2^64 = eps (= 2^32 - 1), 2^96 = -1 reduction — all in wrapping u64
+    numpy ops (same identity chain as the device kernel in
+    goldilocks.py). Used for host twiddle/power-table construction where
+    per-element python ints are too slow and device round-trips cost a
+    remote compile each."""
+    import numpy as np
+
+    with np.errstate(over="ignore"):
+        u64 = np.uint64
+        M32 = u64(0xFFFFFFFF)
+        a = np.asarray(a, dtype=np.uint64)
+        b = np.asarray(b, dtype=np.uint64)
+        a_lo, a_hi = a & M32, a >> u64(32)
+        b_lo, b_hi = b & M32, b >> u64(32)
+        ll = a_lo * b_lo
+        lh = a_lo * b_hi
+        hl = a_hi * b_lo
+        hh = a_hi * b_hi
+        mid = lh + hl  # may wrap: 65-bit sum
+        mid_carry = (mid < lh).astype(np.uint64)
+        lo128 = ll + (mid << u64(32))
+        lo_carry = (lo128 < ll).astype(np.uint64)
+        hi128 = hh + (mid >> u64(32)) + (mid_carry << u64(32)) + lo_carry
+        # reduce: x = lo128 + hi128*2^64, hi128 = hi_hi*2^32 + hi_lo
+        #   2^64 = eps, 2^96 = -1  =>  x = lo128 + hi_lo*eps - hi_hi
+        hi_lo = hi128 & M32
+        hi_hi = hi128 >> u64(32)
+        t0 = lo128 - hi_hi
+        borrow = (lo128 < hi_hi).astype(np.uint64)
+        t0 -= borrow * u64(EPSILON)  # the wrapped excess 2^64 = eps
+        t1 = hi_lo * u64(EPSILON)  # exact: < 2^64
+        res = t0 + t1
+        carry = (res < t1).astype(np.uint64)
+        res += carry * u64(EPSILON)
+        # canonicalize
+        ge = res >= u64(P)
+        res = np.where(ge, res - u64(P), res)
+        return res
+
+
+def powers_np(base: int, count: int):
+    """[1, b, ..., b^(count-1)] as a uint64 numpy array (log-doubling)."""
+    import numpy as np
+
+    out = np.ones(count, dtype=np.uint64)
+    if count <= 1:
+        return out
+    cur = 1
+    while cur < count:
+        step = np.uint64(pow_(base, cur))
+        nxt = min(cur, count - cur)
+        out[cur : cur + nxt] = mul_np(out[:nxt], step)
+        cur += nxt
+    return out
+
+
 def from_u64_with_reduction(x: int) -> int:
     return x % P
 

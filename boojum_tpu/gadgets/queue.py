@@ -43,6 +43,8 @@ class CircuitQueue:
         assert len(element_vars) == self.element_width
         self.tail = circuit_hash_leaf(cs, list(element_vars) + self.tail)
         self.length = self.length.add_constant(cs, 1)
+        # UInt32::add_no_overflow parity (reference mod.rs:186)
+        decompose_and_check(cs, self.length.var, 32)
         self._witness.append(
             [cs.get_value(v) for v in element_vars]
         )
@@ -74,6 +76,9 @@ class CircuitQueue:
             for a, b in zip(new_tail, self.tail)
         ]
         incremented = self.length.add_constant(cs, 1)
+        # range-check the incremented length (the reference uses
+        # UInt32::add_no_overflow here, mod.rs:277) — mirrors pop's guard
+        decompose_and_check(cs, incremented.var, 32)
         self.length = Num.select(cs, execute, incremented, self.length)
         if execute.get_value(cs):
             self._witness.append([cs.get_value(v) for v in element_vars])

@@ -94,9 +94,11 @@ def _rc_pair(rc_ref, r, i, like):
     return lo, hi
 
 
-def _permutation_body(rc_ref, cols):
-    """All 30 rounds on a list of 12 limb-pair (T, 128) values."""
-    cols = _external_mds(cols)
+def _permutation_planes_stacked(rc_ref, lo, hi):
+    """All 30 rounds on stacked (12, T, 128) limb planes (stacked in/out:
+    the fori_loop carries below need array carries, and callers that loop
+    over chunks carry the stacked form too)."""
+    carry = _stack(_external_mds(_unstack(lo, hi)))
 
     def full_round(r, carry):
         lo, hi = carry
@@ -113,11 +115,16 @@ def _permutation_body(rc_ref, cols):
         cs[0] = _sbox7(limbs.add(cs[0], _rc_pair(rc_ref, r, 0, cs[0])))
         return _stack(_internal_mds(cs))
 
-    carry = _stack(cols)
     carry = jax.lax.fori_loop(0, 4, full_round, carry)
     carry = jax.lax.fori_loop(4, 26, partial_round, carry)
     carry = jax.lax.fori_loop(26, 30, full_round, carry)
-    return _unstack(*carry)
+    return carry
+
+
+def _permutation_body(rc_ref, cols):
+    """All 30 rounds on a list of 12 limb-pair (T, 128) values."""
+    lo, hi = _permutation_planes_stacked(rc_ref, *_stack(cols))
+    return _unstack(lo, hi)
 
 
 def _perm_kernel(rc_ref, lo_ref, hi_ref, out_lo_ref, out_hi_ref):
@@ -132,18 +139,28 @@ def _sponge_kernel(num_chunks: int, rc_ref, vlo_ref, vhi_ref, olo_ref, ohi_ref):
     """Overwrite-mode sponge over (L, T, 128) leaf-value planes -> (4, T, 128).
 
     L is padded to 8*num_chunks with zeros by the wrapper; each chunk
-    overwrites the rate portion (state[0:8]) then permutes."""
-    zero = jnp.zeros(vlo_ref.shape[1:], jnp.uint32)
-    state = [(zero, zero)] * 12
-    for c in range(num_chunks):
-        rate = [
-            (vlo_ref[8 * c + j], vhi_ref[8 * c + j]) for j in range(8)
-        ]
-        state = rate + state[8:]
-        state = _permutation_body(rc_ref, state)
-    lo, hi = _stack(state[:4])
-    olo_ref[:] = lo
-    ohi_ref[:] = hi
+    overwrites the rate portion (state[0:8]) then permutes.
+
+    The chunk loop is a fori_loop with a dynamic leading-axis slice into
+    the value refs: a Python-unrolled loop would trace num_chunks copies
+    of the whole permutation — for wide leaves that is tens of thousands
+    of jaxpr eqns PER GRAPH that inlines this kernel, minutes of pure
+    tracing in every fresh process (the round-3 'compile bill' mystery)."""
+    import jax.lax as lax
+
+    zero12 = jnp.zeros((12,) + vlo_ref.shape[1:], jnp.uint32)
+
+    def chunk_body(c, carry):
+        lo, hi = carry
+        rlo = vlo_ref[pl.ds(8 * c, 8)]
+        rhi = vhi_ref[pl.ds(8 * c, 8)]
+        lo = jnp.concatenate([rlo, lo[8:]], axis=0)
+        hi = jnp.concatenate([rhi, hi[8:]], axis=0)
+        return _permutation_planes_stacked(rc_ref, lo, hi)
+
+    lo, hi = lax.fori_loop(0, num_chunks, chunk_body, (zero12, zero12))
+    olo_ref[:] = lo[:4]
+    ohi_ref[:] = hi[:4]
 
 
 from jax.experimental import pallas as pl  # noqa: E402

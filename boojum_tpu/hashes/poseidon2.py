@@ -112,10 +112,17 @@ def _sponge_hash_device(values: jax.Array, permutation) -> jax.Array:
     L = values.shape[-1]
     state = jnp.zeros(lead + (12,), jnp.uint64)
     full = L // 8
-    for c in range(full):
-        chunk = values[..., 8 * c : 8 * c + 8]
-        state = jnp.concatenate([chunk, state[..., 8:]], axis=-1)
-        state = permutation(state)
+    # fori_loop + dynamic slice: an unrolled chunk loop would trace the
+    # permutation `full` times in every graph that inlines this sponge
+    # (see the pallas kernel's identical note)
+
+    def _absorb(c, st):
+        chunk = jax.lax.dynamic_slice_in_dim(values, 8 * c, 8, axis=-1)
+        st = jnp.concatenate([chunk, st[..., 8:]], axis=-1)
+        return permutation(st)
+
+    if full > 0:  # fori traces the body even for a 0-trip count
+        state = jax.lax.fori_loop(0, full, _absorb, state)
     rem = L - 8 * full
     if rem > 0:
         chunk = values[..., 8 * full :]

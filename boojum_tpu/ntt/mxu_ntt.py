@@ -96,7 +96,7 @@ def _brev(log_n: int) -> np.ndarray:
 
 
 def _pow_table(base: int, count: int) -> np.ndarray:
-    return np.array(gl.powers(base, count), dtype=np.uint64)
+    return gl.powers_np(base, count)
 
 
 def _digits8_np(x: np.ndarray):

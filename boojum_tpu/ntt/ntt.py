@@ -40,17 +40,23 @@ def bitreverse_indices(log_n: int) -> np.ndarray:
 
 
 def powers_device(base: int, count: int) -> jax.Array:
-    """[1, b, b^2, ..., b^(count-1)] built with log2(count) vector muls."""
+    """[1, b, b^2, ..., b^(count-1)] as a host-built table.
+
+    Host numpy + one upload (or a graph constant when called inside a
+    trace): the previous log-doubling DEVICE loop dispatched ~2*log2(count)
+    eager executables with shape-unique cache keys — through the tunneled
+    compile service that was ~1s of compile round-trip EACH, every fresh
+    process, for every twiddle/power table."""
     assert count & (count - 1) == 0, "count must be a power of two"
-    pows = jnp.asarray(np.array([1], dtype=np.uint64))
-    b = base % gl.P
-    cur = 1
-    while cur < count:
-        # pows[cur:2cur] = pows[:cur] * b^cur
-        step = jnp.uint64(pow(b, cur, gl.P))
-        pows = jnp.concatenate([pows, gf.mul(pows, step)])
-        cur *= 2
-    return pows
+    # ensure_compile_time_eval: first touch may happen inside a jit trace,
+    # where a bare jnp.asarray would yield a (leakable) constant tracer
+    with jax.ensure_compile_time_eval():
+        return jnp.asarray(_powers_np(base % gl.P, count))
+
+
+@lru_cache(maxsize=64)
+def _powers_np(base: int, count: int) -> np.ndarray:
+    return gl.powers_np(base, count)
 
 
 class NTTContext:
@@ -64,8 +70,8 @@ class NTTContext:
         self.omega_inv = gl.inv(self.omega)
         half = max(self.n // 2, 1)
         # contexts are cached across jit traces (lru_cache below): build the
-        # tables eagerly even if first touched inside a trace, or the cached
-        # arrays would be leaked tracers
+        # tables under ensure_compile_time_eval even if first touched inside
+        # a trace, or the cached arrays would be leaked tracers
         with jax.ensure_compile_time_eval():
             self.n_inv = jnp.uint64(gl.inv(self.n))
             self.tw = powers_device(self.omega, half) if self.n > 1 else None
@@ -244,11 +250,11 @@ def _lde_scale_cached(log_n: int, lde_factor: int, coset: int) -> jax.Array:
     log_lde = lde_factor.bit_length() - 1
     w_full = gl.omega(log_n + log_lde)
     brev_lde = bitreverse_indices(log_lde)
+    shifts = [
+        gl.mul(coset % gl.P, gl.pow_(w_full, int(j))) for j in brev_lde
+    ]
     with jax.ensure_compile_time_eval():
-        shifts = [
-            gl.mul(coset % gl.P, gl.pow_(w_full, int(j))) for j in brev_lde
-        ]
-        return jnp.stack([powers_device(s, n) for s in shifts])
+        return jnp.asarray(np.stack([_powers_np(s, n) for s in shifts]))
 
 
 def lde_from_monomial(

@@ -245,6 +245,12 @@ def _coset_eval(mono_stack, scale_row):
         return fft_natural_to_bitreversed(scaled)
     out = jnp.zeros((B, n), jnp.uint64)
     for i in range(0, B, per):
+        # derive each chunk's input THROUGH the accumulated output (an
+        # optimization_barrier ties them): the chunks are otherwise
+        # data-independent and nothing would stop XLA's scheduler from
+        # materializing several chunk transients concurrently — the memory
+        # bound must be enforced by dataflow, not scheduler luck
+        mono_stack, out = jax.lax.optimization_barrier((mono_stack, out))
         chunk = gf.mul(mono_stack[i : i + per], scale_row[None, :])
         chunk = fft_natural_to_bitreversed(chunk)
         out = jax.lax.dynamic_update_slice_in_dim(out, chunk, i, axis=0)
@@ -974,10 +980,15 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # BOOJUM_TPU_SYNC_SWEEPS=1 forces barriers at any size, =0 disables
         # them even at large n.
         _sv = _os.environ.get("BOOJUM_TPU_SYNC_SWEEPS", "").strip().lower()
-        if _sv in ("0", "false"):
+        if _sv in ("0", "false", "off", "no"):
             _sync_sweeps = False
-        elif _sv:
+        elif _sv in ("1", "true", "on", "yes"):
             _sync_sweeps = True
+        elif _sv:
+            raise ValueError(
+                f"BOOJUM_TPU_SYNC_SWEEPS={_sv!r}: use 1/true/on/yes or "
+                f"0/false/off/no"
+            )
         else:
             _sync_sweeps = n >= (1 << 19)
         T_parts0, T_parts1 = [], []

@@ -138,6 +138,34 @@ class TestMXUNTTKernel:
             assert (v - int(x)) % gl.P == 0, hex(int(x))
             assert all(-128 <= int(digs[k, i]) <= 127 for k in range(8))
 
+    def test_kernel_digit_planes_boundaries(self):
+        """Pin the KERNEL-side digit extraction (_digit_planes: u32-pair
+        gt comparison, lo!=0 carry, byte carry chain) at the exact _M_BAL
+        tie-break — hi == 0x7F7F7F7F with lo on/around the boundary — and
+        at the lo==0 carry special case; the host bake (_digits8_np) is the
+        independently-implemented reference."""
+        from boojum_tpu.field import limbs
+        from boojum_tpu.ntt.mxu_ntt import _M_BAL, _digit_planes, _digits8_np
+
+        cases = np.array(
+            [_M_BAL - 1, _M_BAL, _M_BAL + 1,
+             # hi exactly at the tie-break word, lo sweeping the switch
+             (0x7F7F7F7F << 32) | 0x00000000,
+             (0x7F7F7F7F << 32) | 0x7F7F7F7E,
+             (0x7F7F7F7F << 32) | 0x7F7F7F7F,
+             (0x7F7F7F7F << 32) | 0x7F7F7F80,
+             (0x7F7F7F7F << 32) | 0xFFFFFFFF,
+             # x > M with lo == 0: the (gt & lo != 0) carry branch
+             1 << 63, (0x80000000 << 32),
+             (0xFFFFFFFF << 32), gl.P - 1, gl.P - (1 << 32)],
+            dtype=np.uint64,
+        )
+        want = np.asarray(_digits8_np(cases)).astype(np.int64)
+        lo, hi = limbs.split_np(cases)
+        got_planes = _digit_planes((jnp.asarray(lo), jnp.asarray(hi)))
+        got = np.stack([np.asarray(p) for p in got_planes]).astype(np.int64)
+        assert (got == want).all(), np.nonzero((got != want).any(axis=0))
+
     def _data(self, log_n, cols=2, seed=30):
         a = _rand((cols, 1 << log_n), seed)
         # adversarial rows: all p-1 (max limbs everywhere) and small values
