@@ -33,9 +33,18 @@ from . import poseidon2_params as params
 _RC = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
 _DIAG = np.array(params.M_I_DIAGONAL, dtype=np.uint64)
 
-# (30, 12) limb pairs -> (30, 24) u32: [lo(12) | hi(12)] per round
-_RC_U32 = np.concatenate(limbs.split_np(_RC), axis=1)
-_DIAG_PAIRS = [limbs.const_pair(int(d)) for d in _DIAG]
+# (30, 12) limb pairs -> (30, 24) u32: [lo(12) | hi(12)] per round, plus a
+# 31st row carrying the M_I diagonal in the same [lo | hi] layout — pallas
+# kernels cannot close over array constants, so the diagonal rides the same
+# SMEM table as the round constants
+_RC_U32 = np.concatenate(
+    [
+        np.concatenate(limbs.split_np(_RC), axis=1),
+        np.concatenate(limbs.split_np(_DIAG[None, :]), axis=1),
+    ],
+    axis=0,
+)
+_DIAG_ROW = 30
 
 
 def _sbox7(x):
@@ -45,75 +54,82 @@ def _sbox7(x):
     return limbs.mul(x4, x3)
 
 
-def _block_m4(x0, x1, x2, x3):
+# The whole permutation is VECTORIZED over the state axis: every step is a
+# limbs op on stacked (12, T, 128) (or (3, T, 128) group) planes. A
+# per-element formulation traced ~800 jaxpr eqns PER ROUND BODY, and every
+# graph that inlines a commit re-traced it — minutes of pure tracing per
+# fresh process. Element order and add association match the per-element
+# form exactly; field ops are exact mod p, so results are bit-identical.
+
+
+def _external_mds_planes(lo, hi):
+    """M_E on stacked (12, T, 128) limb planes: 3 groups x the width-4 M4
+    block, then the cross-group sums (same 4b+i element order as the
+    reference's per-element loop)."""
     add, dbl = limbs.add, limbs.double
-    t0 = add(x0, x1)
-    t1 = add(x2, x3)
-    t2 = add(dbl(x1), t1)
-    t3 = add(dbl(x3), t0)
+    tail = lo.shape[1:]
+    glo = lo.reshape((3, 4) + tail)
+    ghi = hi.reshape((3, 4) + tail)
+    X = [(glo[:, i], ghi[:, i]) for i in range(4)]  # (3, T, 128) pairs
+    t0 = add(X[0], X[1])
+    t1 = add(X[2], X[3])
+    t2 = add(dbl(X[1]), t1)
+    t3 = add(dbl(X[3]), t0)
     t4 = add(dbl(dbl(t1)), t3)
     t5 = add(dbl(dbl(t0)), t2)
-    t6 = add(t3, t5)
-    t7 = add(t2, t4)
-    return t6, t5, t7, t4
+    B = [add(t3, t5), t5, add(t2, t4), t4]  # block outputs per position
+    out_lo, out_hi = [], []
+    for i in range(4):
+        blo, bhi = B[i]
+        s = add(add((blo[0], bhi[0]), (blo[1], bhi[1])), (blo[2], bhi[2]))
+        o = add(B[i], s)  # (3,T,128) + (T,128) broadcast
+        out_lo.append(o[0])
+        out_hi.append(o[1])
+    olo = jnp.stack(out_lo, axis=1).reshape((12,) + tail)
+    ohi = jnp.stack(out_hi, axis=1).reshape((12,) + tail)
+    return olo, ohi
 
 
-def _external_mds(cols):
-    add = limbs.add
-    blocks = [_block_m4(*cols[4 * b : 4 * b + 4]) for b in range(3)]
-    sums = [
-        add(add(blocks[0][i], blocks[1][i]), blocks[2][i]) for i in range(4)
-    ]
-    return [add(blocks[b][i], sums[i]) for b in range(3) for i in range(4)]
+def _internal_mds_planes(rc_ref, lo, hi):
+    """M_I = all-ones + diag(d) on stacked planes."""
+    total = (lo[0], hi[0])
+    for i in range(1, 12):
+        total = limbs.add(total, (lo[i], hi[i]))
+    scaled = limbs.mul((lo, hi), _rc_row(rc_ref, _DIAG_ROW, lo[0]))
+    return limbs.add(scaled, total)  # (12,T,128) + (T,128) broadcast
 
 
-def _internal_mds(cols):
-    total = cols[0]
-    for c in cols[1:]:
-        total = limbs.add(total, c)
-    return [
-        limbs.add(limbs.mul_const(cols[i], _DIAG_PAIRS[i]), total)
-        for i in range(12)
-    ]
-
-
-def _stack(cols):
-    """12 (lo, hi) pairs of (T, 128) -> (lo12, hi12) stacked (12, T, 128)."""
-    lo = jnp.stack([c[0] for c in cols])
-    hi = jnp.stack([c[1] for c in cols])
-    return lo, hi
-
-
-def _unstack(lo, hi):
-    return [(lo[i], hi[i]) for i in range(12)]
-
-
-def _rc_pair(rc_ref, r, i, like):
-    lo = jnp.full_like(like[0], rc_ref[r, i])
-    hi = jnp.full_like(like[1], rc_ref[r, 12 + i])
-    return lo, hi
+def _rc_row(rc_ref, r, like):
+    """Row-r constants from SMEM as (12, T, 128) planes (stacked full
+    tiles: Mosaic rejects reshaping a 1-D vector into broadcastable 3-D)."""
+    rlo = jnp.stack(
+        [jnp.full_like(like, rc_ref[r, i]) for i in range(12)]
+    )
+    rhi = jnp.stack(
+        [jnp.full_like(like, rc_ref[r, 12 + i]) for i in range(12)]
+    )
+    return rlo, rhi
 
 
 def _permutation_planes_stacked(rc_ref, lo, hi):
-    """All 30 rounds on stacked (12, T, 128) limb planes (stacked in/out:
-    the fori_loop carries below need array carries, and callers that loop
-    over chunks carry the stacked form too)."""
-    carry = _stack(_external_mds(_unstack(lo, hi)))
+    """All 30 rounds on stacked (12, T, 128) limb planes."""
+    carry = _external_mds_planes(lo, hi)
 
     def full_round(r, carry):
         lo, hi = carry
-        cs = _unstack(lo, hi)
-        cs = [
-            _sbox7(limbs.add(c, _rc_pair(rc_ref, r, i, c)))
-            for i, c in enumerate(cs)
-        ]
-        return _stack(_external_mds(cs))
+        s = limbs.add((lo, hi), _rc_row(rc_ref, r, lo[0]))
+        return _external_mds_planes(*_sbox7(s))
 
     def partial_round(r, carry):
         lo, hi = carry
-        cs = _unstack(lo, hi)
-        cs[0] = _sbox7(limbs.add(cs[0], _rc_pair(rc_ref, r, 0, cs[0])))
-        return _stack(_internal_mds(cs))
+        rc0 = (
+            jnp.full_like(lo[0], rc_ref[r, 0]),
+            jnp.full_like(hi[0], rc_ref[r, 12]),
+        )
+        el = _sbox7(limbs.add((lo[0], hi[0]), rc0))
+        lo = jnp.concatenate([el[0][None], lo[1:]], axis=0)
+        hi = jnp.concatenate([el[1][None], hi[1:]], axis=0)
+        return _internal_mds_planes(rc_ref, lo, hi)
 
     carry = jax.lax.fori_loop(0, 4, full_round, carry)
     carry = jax.lax.fori_loop(4, 26, partial_round, carry)
@@ -121,16 +137,8 @@ def _permutation_planes_stacked(rc_ref, lo, hi):
     return carry
 
 
-def _permutation_body(rc_ref, cols):
-    """All 30 rounds on a list of 12 limb-pair (T, 128) values."""
-    lo, hi = _permutation_planes_stacked(rc_ref, *_stack(cols))
-    return _unstack(lo, hi)
-
-
 def _perm_kernel(rc_ref, lo_ref, hi_ref, out_lo_ref, out_hi_ref):
-    cols = [(lo_ref[i], hi_ref[i]) for i in range(12)]
-    cols = _permutation_body(rc_ref, cols)
-    lo, hi = _stack(cols)
+    lo, hi = _permutation_planes_stacked(rc_ref, lo_ref[:], hi_ref[:])
     out_lo_ref[:] = lo
     out_hi_ref[:] = hi
 
@@ -152,13 +160,18 @@ def _sponge_kernel(num_chunks: int, rc_ref, vlo_ref, vhi_ref, olo_ref, ohi_ref):
 
     def chunk_body(c, carry):
         lo, hi = carry
-        rlo = vlo_ref[pl.ds(8 * c, 8)]
-        rhi = vhi_ref[pl.ds(8 * c, 8)]
+        # i32 offset arithmetic: under the global x64 flag a bare 8*c is
+        # i64 and Mosaic's muli verifier rejects the mixed-width product
+        off = jnp.int32(8) * c
+        rlo = vlo_ref[pl.ds(off, 8)]
+        rhi = vhi_ref[pl.ds(off, 8)]
         lo = jnp.concatenate([rlo, lo[8:]], axis=0)
         hi = jnp.concatenate([rhi, hi[8:]], axis=0)
         return _permutation_planes_stacked(rc_ref, lo, hi)
 
-    lo, hi = lax.fori_loop(0, num_chunks, chunk_body, (zero12, zero12))
+    lo, hi = lax.fori_loop(
+        jnp.int32(0), jnp.int32(num_chunks), chunk_body, (zero12, zero12)
+    )
     olo_ref[:] = lo[:4]
     ohi_ref[:] = hi[:4]
 
@@ -176,7 +189,7 @@ def _smem_spec():
     # explicit block + index map: the default index map traces i64 under the
     # global x64 flag, which Mosaic cannot legalize
     return pl.BlockSpec(
-        (30, 24), imap32(lambda *_: (0, 0)), memory_space=pltpu.SMEM
+        (31, 24), imap32(lambda *_: (0, 0)), memory_space=pltpu.SMEM
     )
 
 
