@@ -418,10 +418,27 @@ def powers_device_base(base_arr, count: int):
     return pows[:count]
 
 
+@jax.jit
+def _coset_eval_q(mono_stack, scale_q, c_arr):
+    """One group's coset evaluation: scale row c of scale_q, forward NTT.
+
+    A TOP-LEVEL executable on purpose: inlining the four group evaluations
+    into the terms graph quadrupled that graph's NTT content and pushed
+    its remote compile alone to ~440s (plus minutes of tracing) — split,
+    each shape compiles once in tens of seconds and is reused across all
+    cosets and proofs."""
+    scale_row = jax.lax.dynamic_index_in_dim(
+        scale_q, c_arr, 0, keepdims=False
+    )
+    return _coset_eval(mono_stack, scale_row)
+
+
 def _coset_sweep_fn(assembly, setup, lk_ctx):
-    """Assembly-cached fused per-coset quotient sweep: the 4 group coset
-    evaluations + gate sweep + copy-permutation + lookup terms + 1/Z_H in
-    ONE graph. Reused across cosets AND proofs (challenges are array args).
+    """Assembly-cached fused per-coset quotient TERMS graph: gate sweep +
+    copy-permutation + lookup terms + 1/Z_H over already-evaluated coset
+    values (the 4 group evaluations run as separate _coset_eval_q
+    dispatches). Reused across cosets AND proofs (challenges are array
+    args).
 
     The closure captures only structural data (gate sweep fn, counts,
     paths) — never the assembly/setup objects, so re-witnessed clones can
@@ -446,23 +463,16 @@ def _coset_sweep_fn(assembly, setup, lk_ctx):
         assembly._gate_sweep_jit = gate_fn
 
     def body(
-        wit_mono, setup_mono, s2_mono, zs_mono, c_arr, scale_q,
+        wit_v, setup_v, s2_v, zs_v, c_arr,
         xs_q, l0_q, zhinv_q, ap0, ap1, beta01, gamma01, lkb01, lkg01,
     ):
         from .stages import AlphaPows as AP
 
-        n = wit_mono.shape[-1]
-        scale_row = jax.lax.dynamic_index_in_dim(
-            scale_q, c_arr, 0, keepdims=False
-        )
+        n = wit_v.shape[-1]
         start = c_arr * n
         xs_sl = jax.lax.dynamic_slice_in_dim(xs_q, start, n)
         l0_sl = jax.lax.dynamic_slice_in_dim(l0_q, start, n)
         zhinv_sl = jax.lax.dynamic_slice_in_dim(zhinv_q, start, n)
-        wit_v = _coset_eval(wit_mono, scale_row)
-        setup_v = _coset_eval(setup_mono, scale_row)
-        s2_v = _coset_eval(s2_mono, scale_row)
-        zs_v = _coset_eval(zs_mono, scale_row)
         copy_v = wit_v[:Ct]
         gate_wit_v = wit_v[Ct : Ct + W] if W else None
         sigma_v = setup_v[:Ct]
@@ -960,7 +970,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         mk_path = setup.selector_paths[assembly.lookup_marker_gid()]
 
     if fused:
-        # one fused dispatch per coset (+1 for the alpha table, +1 tail)
+        # five dispatches per coset (4 group evals + 1 terms graph, ~10 ms
+        # RTT each) — deliberately NOT one fused graph: the fused form's
+        # remote compile alone was ~440s (see _coset_eval_q)
         ap = AlphaPows(alpha, total_alpha_terms)
         zero2 = jnp.zeros((2,), jnp.uint64)
         lk_ctx = (
@@ -993,9 +1005,14 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             _sync_sweeps = n >= (1 << 19)
         T_parts0, T_parts1 = [], []
         for c in range(Q):
+            ci = jnp.int32(c)
+            wit_v = _coset_eval_q(wit_mono, scale_q, ci)
+            setup_v = _coset_eval_q(setup.setup_monomials, scale_q, ci)
+            s2_v = _coset_eval_q(s2_mono, scale_q, ci)
+            zs_v = _coset_eval_q(zs_mono, scale_q, ci)
             t0c, t1c = sweep(
-                wit_mono, setup.setup_monomials, s2_mono, zs_mono,
-                jnp.int32(c), scale_q, xs_q, l0_q, zh_inv_q,
+                wit_v, setup_v, s2_v, zs_v,
+                ci, xs_q, l0_q, zh_inv_q,
                 ap.p0, ap.p1, beta01, gamma01,
                 lkb01 if lkb01 is not None else zero2,
                 lkg01 if lkg01 is not None else zero2,
