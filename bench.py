@@ -57,21 +57,36 @@ def _enable_compile_cache():
     try:
         import jax
 
-        # NOT the tests' .jax_cache, and salted by the platform string: the
-        # axon remote compile service runs on a different host, and its
-        # CPU-flavored AOT entries SIGILL the local machine when a local CPU
-        # process loads them — caches from different platforms must never
-        # mix (same rule as boojum_tpu/__init__.py's default cache)
+        # NOT the tests' .jax_cache, and salted by the platform string AND
+        # the local host's CPU fingerprint: the axon remote compile service
+        # runs on a different host, and its CPU-flavored AOT entries SIGILL
+        # the local machine when a local CPU process loads them — caches
+        # from different platforms or hosts must never mix (same rule as
+        # boojum_tpu/__init__.py's default cache; two segfaults in round 4
+        # traced to cross-host CPU AOT entries). _hostfp is loaded by file
+        # path so boojum_tpu/__init__'s side effects don't fire yet.
+        import importlib.util as _ilu
+
+        _root = os.path.dirname(os.path.abspath(__file__))
+        _spec = _ilu.spec_from_file_location(
+            "_bt_hostfp", os.path.join(_root, "boojum_tpu", "_hostfp.py")
+        )
+        _hostfp = _ilu.module_from_spec(_spec)
+        _spec.loader.exec_module(_hostfp)
+
         plat = (
             os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
             or "default"
         )
         cache = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)),
-            f".jax_cache_bench_{plat}",
+            _root, f".jax_cache_bench_{plat}_{_hostfp.host_fingerprint()}"
         )
         jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        # cache EVERYTHING: behind the tunnel even a "cheap" compile is a
+        # multi-second RPC, and a fresh process re-pays it for every graph
+        # below the threshold (the 2^16 prove traces ~500 distinct graphs;
+        # at the default 1.0s threshold ~400 of them recompiled every run)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass

@@ -20,14 +20,18 @@ jax.config.update("jax_enable_x64", True)
 # keyed by (shape, geometry); caching them on disk means only the first-ever
 # run of a given circuit shape pays XLA compile time. Opt out with
 # BOOJUM_TPU_NO_COMPILE_CACHE=1 or by pre-setting jax_compilation_cache_dir.
+from ._hostfp import host_fingerprint as _host_fingerprint
+
+
 if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
     try:
         if not jax.config.jax_compilation_cache_dir:
-            # one cache dir PER PLATFORM STRING: a remote-TPU process (e.g.
-            # JAX_PLATFORMS=axon) gets its host-side CPU AOT pieces compiled
-            # by the remote service with the REMOTE machine's features, and
-            # loading those entries in a local CPU process SIGILLs — the two
-            # worlds must never share a cache
+            # one cache dir PER PLATFORM STRING and PER HOST FINGERPRINT: a
+            # remote-TPU process (e.g. JAX_PLATFORMS=axon) gets its
+            # host-side CPU AOT pieces compiled by the remote service with
+            # the REMOTE machine's features, and loading those entries in a
+            # local CPU process SIGILLs — and the same applies to local CPU
+            # entries carried to a different host (see _host_fingerprint)
             _plat = (
                 os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
                 or "default"
@@ -36,7 +40,9 @@ if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
                 "jax_compilation_cache_dir",
                 os.environ.get(
                     "BOOJUM_TPU_COMPILE_CACHE",
-                    os.path.expanduser(f"~/.cache/boojum_tpu_xla-{_plat}"),
+                    os.path.expanduser(
+                        f"~/.cache/boojum_tpu_xla-{_plat}-{_host_fingerprint()}"
+                    ),
                 ),
             )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

@@ -1,0 +1,32 @@
+"""Host CPU fingerprint for compile-cache-dir salting. ZERO heavy imports.
+
+XLA:CPU AOT executables embed the COMPILE machine's vector features and
+jax's cache key does NOT include them — loading an entry produced on a
+machine with different features SIGILLs/segfaults (observed twice in
+round 4: `cpu_aot_loader.cc` machine-feature mismatch warnings, then a
+crash inside the cached-executable load). Salting every persistent-cache
+directory with the local feature set makes a host change invalidate the
+cache instead of crashing the process.
+
+This module deliberately imports nothing beyond hashlib/platform so that
+conftest.py, bench.py and scripts/ can load it by file path (see
+`load_host_fingerprint` docstring) WITHOUT triggering boojum_tpu/__init__'s
+jax-config side effects before they have pinned their own platform/env.
+"""
+
+import hashlib
+import platform
+
+
+def host_fingerprint() -> str:
+    """Short stable hash of this host's CPU feature set."""
+    desc = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    desc += " " + " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        pass
+    return hashlib.sha256(desc.encode()).hexdigest()[:8]

@@ -29,9 +29,21 @@ try:
     jax.config.update("jax_platforms", "cpu")
     xla_bridge._backend_factories.pop("axon", None)
     # XLA:CPU compiles of the big unrolled prover graphs take minutes; cache
-    # them persistently so only the first-ever run pays.
-    _cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".jax_cache")
+    # them persistently so only the first-ever run pays. The dir is salted
+    # with the host CPU fingerprint: XLA:CPU AOT entries embed the compile
+    # machine's vector features and loading them on a different host
+    # segfaults (boojum_tpu/_hostfp.py has the full story). Loaded by file
+    # path so boojum_tpu/__init__'s jax-config side effects don't fire here.
+    import importlib.util as _ilu
+
+    _root = os.path.dirname(os.path.abspath(__file__))
+    _spec = _ilu.spec_from_file_location(
+        "_bt_hostfp", os.path.join(_root, "boojum_tpu", "_hostfp.py")
+    )
+    _hostfp = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_hostfp)
+
+    _cache = os.path.join(_root, f".jax_cache-{_hostfp.host_fingerprint()}")
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
