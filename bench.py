@@ -18,6 +18,20 @@ seconds no matter what: if the full protocol hasn't finished by then, the
 line carries whatever was measured so far plus a "status" field, and the
 process exits 0. A completed run reports status "ok".
 
+Cold-start posture (ISSUE 1): before the first prove the bench runs the
+parallel PRECOMPILE sweep (boojum_tpu/prover/precompile.py) — the split
+prover kernel library compiles concurrently through a thread pool instead
+of serially at first dispatch, and lands in the persistent cache below. A
+compile LEDGER (per-kernel trace/compile seconds, cache hit/miss counts)
+rides along on every JSON line and is written to BENCH_LEDGER_JSON, so a
+timeout is diagnosable from the JSON alone and compile-bill regressions
+are visible across rounds.
+
+Usage: python bench.py [--precompile-only]
+  --precompile-only runs synthesis + the parallel precompile, emits the
+  ledger JSON line and exits — a cache-warming step to run before a bench
+  or a multihost round.
+
 Environment knobs:
   BENCH_CIRCUIT = sha256 (default) | fma
   BENCH_SHA_BYTES = message size (default 8192)
@@ -32,6 +46,16 @@ Environment knobs:
   BENCH_QUERIES = FRI query count (default 50; the reference's LDE-2
       golden proof uses 100)
   BENCH_SKIP_NTT = 1 skips the NTT-throughput side metric
+  BENCH_PRECOMPILE = 0 skips the pre-prove parallel precompile sweep
+  BENCH_PRECOMPILE_WORKERS = thread-pool width for it (default 8)
+  BENCH_CACHE_MAX_BYTES = size cap for each repo-local .jax_cache_bench_*
+      dir; oldest entries are evicted above it (default 8 GiB, 0 disables
+      — min_compile_time_secs=0.0 below persists EVERY graph, so the
+      caches would otherwise grow without bound across shapes and rounds)
+  BENCH_LEDGER_JSON = compile-ledger artifact path (default
+      compile_ledger.json next to this file)
+  BENCH_LOG_COMPILES = 0 disables jax_log_compiles (on by default so the
+      ledger can attribute dispatch-time compiles to graph names)
 """
 
 import json
@@ -50,6 +74,56 @@ def _log(msg):
           file=sys.stderr, flush=True)
 
 
+def _prune_bench_caches(root):
+    """Size-capped prune of every repo-local .jax_cache_bench_* dir.
+
+    jax_persistent_cache_min_compile_time_secs=0.0 below persists EVERY
+    graph (~500 per 2^16 prove) with no eviction of its own, so across
+    shapes and rounds the bench caches grow without bound (ADVICE.md
+    round 4). Above BENCH_CACHE_MAX_BYTES per dir (default 8 GiB, 0
+    disables) the oldest entries by mtime are deleted until under budget —
+    evicting a live entry only costs its recompile."""
+    try:
+        budget = float(
+            os.environ.get("BENCH_CACHE_MAX_BYTES", str(8 << 30))
+        )
+    except ValueError:
+        budget = float(8 << 30)
+    if budget <= 0:
+        return
+    for d in sorted(os.listdir(root)):
+        cache_dir = os.path.join(root, d)
+        if not d.startswith(".jax_cache_bench_") or not os.path.isdir(cache_dir):
+            continue
+        entries = []
+        total = 0
+        for base, _dirs, files in os.walk(cache_dir):
+            for fname in files:
+                p = os.path.join(base, fname)
+                try:
+                    st = os.stat(p)
+                except OSError:
+                    continue
+                entries.append((st.st_mtime, st.st_size, p))
+                total += st.st_size
+        if total <= budget:
+            continue
+        entries.sort()  # oldest first
+        freed = 0
+        for _mtime, size, p in entries:
+            if total - freed <= budget:
+                break
+            try:
+                os.remove(p)
+                freed += size
+            except OSError:
+                pass
+        _log(
+            f"pruned {freed / 2**20:.0f} MiB from {d} "
+            f"({total / 2**20:.0f} MiB > cap {budget / 2**20:.0f} MiB)"
+        )
+
+
 def _enable_compile_cache():
     """Persist compiled executables across bench runs — the remote compile
     service behind the tunnel takes minutes per big fused graph, which
@@ -63,24 +137,25 @@ def _enable_compile_cache():
         # the local machine when a local CPU process loads them — caches
         # from different platforms or hosts must never mix (same rule as
         # boojum_tpu/__init__.py's default cache; two segfaults in round 4
-        # traced to cross-host CPU AOT entries). _hostfp is loaded by file
-        # path so boojum_tpu/__init__'s side effects don't fire yet.
-        import importlib.util as _ilu
+        # traced to cross-host CPU AOT entries). _hostfp is executed by
+        # file path (runpy) so boojum_tpu/__init__'s side effects don't
+        # fire yet. Caveat: for JAX_PLATFORMS=axon the fingerprint only
+        # guards the LOCAL-CPU dimension — the remote compile service
+        # exposes no host identity to fold into the salt (see the
+        # _hostfp.py module docstring).
+        import runpy
 
         _root = os.path.dirname(os.path.abspath(__file__))
-        _spec = _ilu.spec_from_file_location(
-            "_bt_hostfp", os.path.join(_root, "boojum_tpu", "_hostfp.py")
-        )
-        _hostfp = _ilu.module_from_spec(_spec)
-        _spec.loader.exec_module(_hostfp)
+        _fp = runpy.run_path(
+            os.path.join(_root, "boojum_tpu", "_hostfp.py")
+        )["load_host_fingerprint"](_root)
 
         plat = (
             os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
             or "default"
         )
-        cache = os.path.join(
-            _root, f".jax_cache_bench_{plat}_{_hostfp.host_fingerprint()}"
-        )
+        cache = os.path.join(_root, f".jax_cache_bench_{plat}_{_fp}")
+        _prune_bench_caches(_root)
         jax.config.update("jax_compilation_cache_dir", cache)
         # cache EVERYTHING: behind the tunnel even a "cheap" compile is a
         # multi-second RPC, and a fresh process re-pays it for every graph
@@ -93,6 +168,25 @@ def _enable_compile_cache():
 
 
 _enable_compile_cache()
+
+
+def _start_ledger():
+    """Process-wide compile ledger + per-graph compile logging. Runs after
+    the cache dir is pinned (importing boojum_tpu configures jax)."""
+    try:
+        import jax
+
+        if os.environ.get("BENCH_LOG_COMPILES", "").strip() != "0":
+            jax.config.update("jax_log_compiles", True)
+        from boojum_tpu.utils.profiling import start_compile_ledger
+
+        return start_compile_ledger()
+    except Exception as e:
+        _log(f"compile ledger unavailable: {e!r}")
+        return None
+
+
+_LEDGER = _start_ledger()
 
 # ---------------------------------------------------------------------------
 # Watchdog: the driver kills the bench (rc=124, no JSON parsed) if it runs
@@ -154,6 +248,24 @@ def _emit(status):
         }
         if _STATE["ntt_eps"] is not None:
             out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
+        # the compile-ledger summary rides on EVERY line (including the
+        # watchdog's) so a timeout is diagnosable from the JSON alone:
+        # which graph compiled longest, how much the cache saved, whether
+        # the process was still paying compile when the budget ran out
+        if _LEDGER is not None:
+            try:
+                out["compile_ledger"] = _LEDGER.summary()
+                ledger_path = os.environ.get(
+                    "BENCH_LEDGER_JSON",
+                    os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "compile_ledger.json",
+                    ),
+                )
+                _LEDGER.dump_json(ledger_path)
+                out["compile_ledger"]["artifact"] = ledger_path
+            except Exception:
+                pass
         print(json.dumps(out), flush=True)
 
 
@@ -300,6 +412,32 @@ def main():
 
     asm = cs.into_assembly()
     print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
+
+    precompile_only = "--precompile-only" in sys.argv
+    if precompile_only or os.environ.get("BENCH_PRECOMPILE", "").strip() != "0":
+        # overlap the remote compile round-trips BEFORE the first dispatch
+        # pays them serially; everything lands in the persistent cache
+        _STATE["phase"] = "precompile"
+        workers = int(os.environ.get("BENCH_PRECOMPILE_WORKERS", "8"))
+        _log(f"parallel precompile of the kernel library ({workers} workers)")
+        try:
+            from boojum_tpu.prover.precompile import precompile
+
+            led = precompile(
+                asm, config, max_workers=workers, ledger=_LEDGER
+            )
+            _log(
+                "precompile done: "
+                f"{json.dumps(led.summary())}"
+            )
+        except Exception as e:
+            if precompile_only:
+                raise
+            _log(f"precompile failed (continuing to prove): {e!r}")
+    if precompile_only:
+        _emit("precompile_only")
+        return
+
     _STATE["phase"] = "setup"
     _log("generating setup (compiles on a cold cache)")
     setup = generate_setup(asm, config)

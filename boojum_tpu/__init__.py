@@ -25,6 +25,12 @@ from ._hostfp import host_fingerprint as _host_fingerprint
 
 if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
     try:
+        # a host process (bench.py, conftest.py, multihost_worker.py) that
+        # already pinned its cache dir also chose its own persistence
+        # thresholds — leave BOTH alone (this import used to silently
+        # revert bench's min_compile_time_secs=0.0 back to 1.0, dropping
+        # every sub-second kernel from the cache the precompile sweep
+        # fills)
         if not jax.config.jax_compilation_cache_dir:
             # one cache dir PER PLATFORM STRING and PER HOST FINGERPRINT: a
             # remote-TPU process (e.g. JAX_PLATFORMS=axon) gets its
@@ -45,8 +51,12 @@ if not os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
                     ),
                 ),
             )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_entry_size_bytes", 0
+            )
     except Exception:
         pass
 

@@ -9,9 +9,23 @@ directory with the local feature set makes a host change invalidate the
 cache instead of crashing the process.
 
 This module deliberately imports nothing beyond hashlib/platform so that
-conftest.py, bench.py and scripts/ can load it by file path (see
-`load_host_fingerprint` docstring) WITHOUT triggering boojum_tpu/__init__'s
-jax-config side effects before they have pinned their own platform/env.
+conftest.py, bench.py and scripts/ can load it WITHOUT triggering
+boojum_tpu/__init__'s jax-config side effects before they have pinned
+their own platform/env. Call sites use `load_host_fingerprint` via runpy:
+
+    import runpy
+    fp = runpy.run_path(
+        os.path.join(root, "boojum_tpu", "_hostfp.py")
+    )["load_host_fingerprint"](root)
+
+KNOWN LIMIT (axon remote compile service): under JAX_PLATFORMS=axon the
+host-side CPU AOT pieces are produced by the REMOTE compile service's
+machine, whose identity the service does not expose — so this fingerprint
+only guards the local-CPU dimension of the cache. If the service migrates
+to a host with different CPU features, the local salt is unchanged and
+stale entries could still load; there is nothing to fold in until the
+service exposes a version/feature string (bench.py documents the same
+caveat where it builds the axon cache dir).
 """
 
 import hashlib
@@ -19,14 +33,50 @@ import platform
 
 
 def host_fingerprint() -> str:
-    """Short stable hash of this host's CPU feature set."""
+    """Short stable hash of this host's CPU feature set.
+
+    Primary source is the /proc/cpuinfo feature flags. When those are
+    unreadable (macOS, restricted containers), the fallback folds in
+    `platform.processor()` and `platform.node()` on top of the machine
+    arch — two same-arch hosts would otherwise collide on a bare
+    `platform.machine()` and re-expose the cross-host AOT segfault this
+    salt exists to prevent. Deliberate tradeoff: on a fallback host whose
+    hostname is unstable (ephemeral containers) the salt churns and each
+    run starts cold — a cold cache is a cost, a cross-host SIGILL is a
+    crash, and flagless-but-stable-hostname hosts (macOS) keep reuse."""
     desc = platform.machine()
+    flags_found = False
     try:
         with open("/proc/cpuinfo") as f:
             for line in f:
                 if line.startswith(("flags", "Features")):
                     desc += " " + " ".join(sorted(line.split(":", 1)[1].split()))
+                    flags_found = True
                     break
     except OSError:
         pass
+    if not flags_found:
+        desc += f" {platform.processor()} {platform.node()}"
     return hashlib.sha256(desc.encode()).hexdigest()[:8]
+
+
+def load_host_fingerprint(repo_root: str) -> str:
+    """Return the host fingerprint for callers that must not import the
+    `boojum_tpu` package (whose __init__ configures jax on import).
+
+    Executed via `runpy.run_path` on this file (see module docstring) the
+    call is a plain function invocation; if somehow invoked from a module
+    object loaded from a DIFFERENT checkout, it re-loads the _hostfp.py
+    under `repo_root` by file path and delegates, so the fingerprint
+    always matches the code of the repo whose cache is being salted."""
+    import os
+
+    path = os.path.join(repo_root, "boojum_tpu", "_hostfp.py")
+    if os.path.abspath(path) == os.path.abspath(__file__):
+        return host_fingerprint()
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("_bt_hostfp", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.host_fingerprint()

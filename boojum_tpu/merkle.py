@@ -60,6 +60,44 @@ def _tree_layers(leaf_values, cap_size: int):
     return _node_layers(leaf_hash(leaf_values), cap_size)
 
 
+# ---------------------------------------------------------------------------
+# Shape-keyed commit kernels (the compile-bill split, ISSUE 1)
+# ---------------------------------------------------------------------------
+# The fused one-graph-per-commit form (`_commit_fused`) paid a 200s+ remote
+# compile PER ORACLE SHAPE because the NTTs, the leaf sponge and the node
+# layers all landed in one module. Split, each sub-graph compiles in well
+# under a minute AND the node-layer stack — keyed only on (num_leaves, cap),
+# not on the oracle's column count — is compiled ONCE and shared by the
+# witness/stage-2/quotient/setup commits and the streamed-digest path.
+
+
+@jax.jit
+def leaf_digests_device(lde_cols):
+    """(B, ...) committed columns -> (N, 4) leaf digests, one dispatch.
+
+    Accepts the prover's (B, L, n) LDE stacks or already-flat (B, N)
+    columns; the leaf-major transpose happens inside the graph so no
+    intermediate (N, B) matrix is ever dispatched eagerly. Keyed on the
+    column stack shape."""
+    B = lde_cols.shape[0]
+    return leaf_hash(lde_cols.reshape(B, -1).T)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def node_layers_device(digests, cap_size: int):
+    """(N, 4) leaf digests -> all node layers up to the cap, one dispatch.
+
+    Keyed only on (N, cap): every oracle of the same domain size reuses the
+    same executable regardless of how many columns it commits."""
+    return _node_layers(digests, cap_size)
+
+
+def commit_layers_device(lde_cols, cap_size: int):
+    """Column stack -> digest layers (leaves first, cap last) as two
+    shape-keyed dispatches: leaf sponge + shared node stack."""
+    return node_layers_device(leaf_digests_device(lde_cols), cap_size)
+
+
 class MerkleTreeWithCap:
     def __init__(self, leaf_values, cap_size: int, num_elems_per_leaf: int = 1):
         """leaf_values: (num_leaves, leaf_width) uint64 device array.
@@ -96,7 +134,7 @@ class MerkleTreeWithCap:
         assert cap_size & (cap_size - 1) == 0 and n >= cap_size
         tree.cap_size = cap_size
         tree.num_leaves = n
-        tree.layers = list(_node_layers(digests, cap_size))
+        tree.layers = list(node_layers_device(digests, cap_size))
         tree._cap_host = [
             tuple(int(x) for x in row) for row in _host_np(tree.layers[-1])
         ]

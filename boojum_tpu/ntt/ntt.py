@@ -333,6 +333,75 @@ def _write_block(buf, chunk, i: int):
     return jax.lax.dynamic_update_slice_in_dim(buf, chunk, i, axis=0)
 
 
+def chunk_shapes(total_cols: int, bytes_per_col: int) -> list[int]:
+    """Distinct column-chunk heights the chunked transform wrappers below
+    actually dispatch for a (total_cols, …) batch — the shape key set a
+    precompiler must cover (prover/precompile.py)."""
+    per = _col_chunks(total_cols, bytes_per_col)
+    if per is None:
+        return [total_cols]
+    return sorted({min(per, total_cols - i) for i in range(0, total_cols, per)})
+
+
+def ntt_kernel_specs(B: int, log_n: int, lde_factor: int | None = None,
+                     coset: int = gl.MULTIPLICATIVE_GENERATOR,
+                     mono: bool = True) -> list:
+    """(name, jitted_fn, args) triples for the exact top-level executables
+    `monomial_from_values` (when `mono`) and `lde_from_monomial` (when
+    `lde_factor` is given) dispatch for a (B, 2^log_n) column stack —
+    mirroring the MXU-vs-XLA routing, the hybrid-size split and the
+    column chunking, so `fn.lower(*args).compile()` populates the very
+    cache keys the prover later hits. Args are ShapeDtypeStructs (plus
+    static scalars); nothing here allocates device memory."""
+    n = 1 << log_n
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint64)
+
+    specs = []
+    if mono:
+        specs += [
+            (f"imono_b{b}_n{n}", _monomial_from_values_jit, (sds(b, n),))
+            for b in chunk_shapes(B, n * 8)
+        ]
+    if lde_factor is None:
+        return specs
+    L = int(lde_factor)
+    mxu = _mxu_ntt_ready(n, None)
+    for b in chunk_shapes(B, n * 8 * L):
+        if not mxu:
+            specs.append((
+                f"lde_b{b}_n{n}_L{L}",
+                _lde_from_monomial_jit,
+                (sds(b, n), L, int(coset) % gl.P),
+            ))
+            continue
+        from . import mxu_ntt
+        from ..field import limbs
+
+        if log_n > mxu_ntt.MAX_LOG_N:
+            # hybrid sizes: eager coset scale + one _fft_hybrid dispatch
+            specs.append((
+                f"lde_hybrid_b{b}_n{n}_L{L}",
+                mxu_ntt._fft_hybrid,
+                (sds(b, L, n), log_n, False),
+            ))
+            continue
+        ctx = mxu_ntt.get_mxu_ctx(log_n)
+        planes = jax.eval_shape(
+            lambda a: limbs.split(a.reshape(-1, ctx.R, ctx.C)), sds(b, n)
+        )
+        s_planes = jax.eval_shape(
+            lambda s: limbs.split(s.reshape(L, ctx.R, ctx.C)), sds(L, n)
+        )
+        specs.append((
+            f"lde_mxu_b{b}_n{n}_L{L}",
+            mxu_ntt._lde_planes,
+            (planes, s_planes, log_n, False),
+        ))
+    return specs
+
+
 def monomial_from_values(values: jax.Array) -> jax.Array:
     """Values over H (natural order) -> monomial coefficients (column
     batches chunked to bound transient memory)."""

@@ -9,3 +9,4 @@ from .convenience import (
     prove_from_precomputations,
     verify_circuit,
 )
+from .precompile import enumerate_kernels, precompile

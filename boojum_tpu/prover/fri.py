@@ -167,6 +167,51 @@ def _fri_final_fused(c0, c1, shift_inv: int):
     return m0, m1
 
 
+def fri_kernel_specs(base_degree: int, config) -> list:
+    """(name, jitted_fn, args) triples for every top-level executable a
+    fused `fri_prove` dispatches for this (base_degree, config) — the
+    per-schedule-entry commit and fold graphs plus the final
+    interpolation — so prover/precompile.py can compile them concurrently
+    before the first prove. Mirrors the schedule/shape walk of fri_prove;
+    args are ShapeDtypeStructs (no device memory)."""
+
+    def sds(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint64)
+
+    N = base_degree * config.fri_lde_factor
+    log_full = N.bit_length() - 1
+    schedule = fold_schedule(
+        base_degree, config.fri_final_degree,
+        getattr(config, "fri_folding_schedule", None),
+    )
+    num_folds = sum(schedule)
+    specs = []
+    cur = N
+    fold_round = 0
+    cap = config.merkle_tree_cap_size
+    for k in schedule:
+        specs.append((
+            f"fri_commit_k{k}_n{cur}",
+            _fri_commit_fn(k, cap),
+            (sds(cur), sds(cur)),
+        ))
+        tables = tuple(
+            sds(1 << (log_full - fold_round - j - 1)) for j in range(k)
+        )
+        specs.append((
+            f"fri_fold_k{k}_n{cur}",
+            _fri_fold_fn(k),
+            (sds(cur), sds(cur), sds(2), tables),
+        ))
+        fold_round += k
+        cur >>= k
+    shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
+    specs.append((
+        f"fri_final_n{cur}", _fri_final_fused, (sds(cur), sds(cur), shift_inv)
+    ))
+    return specs
+
+
 def fri_prove(
     codeword, transcript, config, base_degree: int, fused: bool = False
 ) -> FriOracles:

@@ -1,0 +1,383 @@
+"""Parallel precompilation of the prover's kernel library (ISSUE 1).
+
+A cold process used to pay the remote compile bill SERIALLY: each fused
+round graph compiled at first dispatch, one at a time, 160-250 s each on
+the tunneled compile service — ~35-45 minutes before the first prove
+(BASELINE.md round 4). With the round graphs split into shape-keyed
+top-level kernels (prover.py / stages.py / merkle.py / streaming.py /
+fri.py), the bill becomes a LIBRARY of small modules that can compile
+concurrently:
+
+- `enumerate_kernels(assembly, config)` derives every shape-keyed
+  executable a fused prove of this (CSGeometry, ProofConfig) will
+  dispatch — the commit pipelines for each oracle, the stage-2 chunk
+  scan/prefix/stack graphs, the per-coset evaluation + terms sweep, the
+  round-4/5 evaluation and DEEP graphs and the FRI schedule — as
+  (name, jitted_fn, ShapeDtypeStruct args) specs. No device memory is
+  allocated.
+- `precompile(...)` lowers the specs serially (tracing is Python/GIL
+  work) and runs `.compile()` on a thread pool: under JAX_PLATFORMS=axon
+  each compile is a blocking RPC that releases the GIL, so the
+  round-trips overlap instead of queueing. Compiled executables land in
+  the fingerprint-salted persistent cache (bench.py,
+  boojum_tpu/__init__.py), which both this process's first prove and
+  every later process read back — re-dispatch pays re-tracing plus a
+  cache load, never the remote compile.
+
+Every lower/compile is timed into a `utils.profiling.CompileLedger`;
+bench.py emits the ledger JSON so compile-bill regressions show up in
+round artifacts.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.profiling import CompileLedger, current_compile_ledger
+
+
+def _sds(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.uint64)
+
+
+def _i32():
+    return jax.ShapeDtypeStruct((), jnp.int32)
+
+
+@dataclass
+class KernelSpec:
+    name: str
+    fn: object  # a jitted callable supporting .lower(*args)
+    args: tuple
+
+
+def _next_pow2(x: int) -> int:
+    c = 1
+    while c < max(x, 1):
+        c *= 2
+    return c
+
+
+def enumerate_kernels(assembly, config) -> list[KernelSpec]:
+    """The shape-keyed kernel library for a fused (meshless) prove of
+    `assembly` under `config`.
+
+    Derivations mirror prover._prove_impl / setup.generate_setup; only
+    circuit STRUCTURE is read (placements, gates, geometry, lookup
+    params) — the witness values and the setup's sigma columns are never
+    touched, so this runs before generate_setup. Deliberately skipped
+    (cheap, query-dependent shapes): the fused query gather, streamed
+    single-column opens, and the PoW grind (host-side)."""
+    from ..merkle import leaf_digests_device, node_layers_device
+    from ..field import extension as ext_f
+    from ..ntt.ntt import _ext_powers_jit, ntt_kernel_specs
+    from .fri import fri_kernel_specs
+    from .setup import build_selector_tree, non_residues_for_copy_permutation
+    from .stages import (
+        _all_chunk_num_den,
+        _lookup_denominators,
+        _z_and_partials,
+        chunk_columns,
+        num_gate_sweep_terms,
+    )
+    from .streaming import COL_BLOCK, _absorb_lde_block, use_streamed_lde
+    from . import prover as P
+
+    n = assembly.trace_len
+    log_n = n.bit_length() - 1
+    L = config.fri_lde_factor
+    N = n * L
+    cap = config.merkle_tree_cap_size
+    geometry = assembly.geometry
+    Cg = assembly.copy_placement.shape[0]
+    LC = assembly.num_lookup_cols
+    Ct = Cg + LC
+    W = assembly.wit_placement.shape[0]
+    lookups = assembly.lookups_enabled
+    lk_mode = assembly.lookup_mode
+    R_args = assembly.num_lookup_subargs
+    M = 1 if lookups else 0
+    K = geometry.num_constant_columns + (1 if lk_mode == "specialized" else 0)
+    lp = assembly.lookup_params
+    TW = (lp.width + 1) if lookups else 0
+    width = lp.width if lookups else 0
+
+    chunks = chunk_columns(Ct, geometry.max_allowed_constraint_degree)
+    num_chunks = len(chunks)
+    num_partials = num_chunks - 1
+    S = 2 * num_chunks + 2 * R_args + 2 * M
+    B_wit = Ct + W + M
+    B_setup = Ct + K + TW
+
+    # quotient degree + selector paths, exactly as generate_setup derives
+    tree, selector_paths = build_selector_tree(assembly.gates)
+    tree_degree, _tree_constants = tree.compute_stats()
+    degree_bound = max(
+        tree_degree, geometry.max_allowed_constraint_degree + 1, 1
+    )
+    derived_q = 1 << (degree_bound - 1).bit_length()
+    Q = config.quotient_degree or derived_q
+    B_q = 2 * Q
+    B_all = B_wit + B_setup + S + B_q
+    non_residues = non_residues_for_copy_permutation(Ct)
+
+    total_cols = B_all
+    stream = use_streamed_lde(total_cols, N)
+    stream_setup = use_streamed_lde(B_setup, N)
+
+    specs: list[KernelSpec] = []
+
+    def add(name, fn, *args):
+        specs.append(KernelSpec(name, fn, args))
+
+    # ---- commit pipelines (witness / stage-2 / quotient / setup) ---------
+    absorb_blocks: set[int] = set()
+
+    def commit_specs(tag, B, streamed, mono=True):
+        for nm, fn, args in ntt_kernel_specs(
+            B, log_n, None if streamed else L, mono=mono
+        ):
+            add(f"{tag}:{nm}", fn, *args)
+        if streamed:
+            for i in range(0, B, COL_BLOCK):
+                absorb_blocks.add(min(COL_BLOCK, B - i))
+        else:
+            add(f"{tag}:leaf_digests", leaf_digests_device, _sds(B, L, n))
+
+    commit_specs("wit", B_wit, stream)
+    commit_specs("s2", S, stream)
+    # the quotient LDE is always materialized, and its monomials come from
+    # _quotient_interp rather than monomial_from_values — no imono kernel
+    commit_specs("q", B_q, False, mono=False)
+    commit_specs("setup", B_setup, stream_setup)
+    for b in sorted(absorb_blocks):
+        add(
+            f"absorb_lde_block_b{b}",
+            _absorb_lde_block, _sds(N, 12), _sds(b, n), L,
+        )
+    add("node_layers", node_layers_device, _sds(N, 4), cap)
+
+    # ---- round 2: chunk products, inversions, prefix product, stack ------
+    sc = (_sds(), _sds())
+    chunks_t = tuple(tuple(c) for c in chunks)
+    add(
+        "chunk_num_den", _all_chunk_num_den,
+        _sds(Ct, n), _sds(Ct, n), _sds(Ct), _sds(n), sc, sc, chunks_t,
+    )
+    pair = lambda *shape: (_sds(*shape), _sds(*shape))  # noqa: E731
+    add("ext_binv_chunks", ext_f.batch_inverse, pair(num_chunks, n))
+    if lookups:
+        lk_cols = _sds(LC, n) if lk_mode == "specialized" else _sds(Cg, n)
+        add(
+            "lookup_denominators", _lookup_denominators,
+            lk_cols, _sds(n), _sds(width + 1, n), sc, sc, R_args, width,
+        )
+        add("ext_binv_lookup", ext_f.batch_inverse, pair(R_args + 1, n))
+    add("z_and_partials", _z_and_partials, pair(num_chunks, n),
+        pair(num_chunks, n))
+    stack_fn = P._stage2_stack_fn(assembly, selector_paths)
+    lk_inv = pair(R_args + 1, n) if lookups else None
+    mult = _sds(n) if lookups else None
+    consts = _sds(K, n) if (lookups and lk_mode == "general") else None
+    add("stage2_stack", stack_fn, pair(n), pair(num_partials, n),
+        lk_inv, mult, consts)
+
+    # ---- round 3: per-coset evaluations + terms sweep + quotient tail ----
+    total_alpha_terms = (
+        num_gate_sweep_terms(assembly)
+        + 1 + num_chunks
+        + ((R_args + 1) if lookups else 0)
+    )
+    capA = _next_pow2(total_alpha_terms)
+    add("zshift", P._zshift_fused, _sds(2, n), _sds())
+    for tag, B in (
+        ("wit", B_wit), ("setup", B_setup), ("s2", S), ("zs", 2)
+    ):
+        add(f"coset_eval_{tag}", P._coset_eval_q,
+            _sds(B, n), _sds(Q, n), _i32())
+    mk_path = None
+    if lookups and lk_mode == "general":
+        mk_path = selector_paths[assembly.lookup_marker_gid()]
+    lk_ctx = (
+        lookups, lk_mode, R_args, width, num_partials, chunks_t,
+        total_alpha_terms, Cg, Ct, W, K, M,
+        tuple(mk_path) if mk_path is not None else None,
+    )
+    sweep = P._coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx)
+    add(
+        "coset_sweep_terms", sweep,
+        _sds(B_wit, n), _sds(B_setup, n), _sds(S, n), _sds(2, n), _i32(),
+        _sds(Q * n), _sds(Q * n), _sds(Q * n), _sds(capA), _sds(capA),
+        _sds(2), _sds(2), _sds(2), _sds(2),
+    )
+    add(
+        "quotient_interp", P._quotient_interp,
+        tuple(_sds(n) for _ in range(Q)), tuple(_sds(n) for _ in range(Q)),
+        Q, n,
+    )
+
+    # ---- rounds 4-5: openings, DEEP, FRI ---------------------------------
+    num_lk = (R_args + 1) if lookups else 0
+    num_pi = len(assembly.public_inputs)
+    add("alpha_powers", _ext_powers_jit, _sds(2), capA)
+    capD = _next_pow2(B_all + 2 + num_lk + num_pi)
+    add("deep_powers", _ext_powers_jit, _sds(2), capD)
+    add("evals_fused", P._evals_fused, _sds(B_all, n), _sds(S, n),
+        _sds(2), _sds(2))
+    add("deep_denoms", P._deep_denoms_fused, _sds(N), _sds(2), _sds(2))
+    add("ext_binv_deep", ext_f.batch_inverse, pair(2, N))
+    deep_blocks: set[int] = set()
+    from ..ntt.ntt import chunk_shapes
+
+    # the setup oracle streams in the DEEP phase iff it was COMMITTED
+    # streamed (prover follows setup.setup_lde, decided per-setup by
+    # generate_setup), independently of the prove-wide stream flag
+    for B, streamed_src in (
+        (B_wit, stream), (B_setup, stream_setup), (S, stream)
+    ):
+        if streamed_src:
+            for i in range(0, B, COL_BLOCK):
+                b32 = min(COL_BLOCK, B - i)
+                deep_blocks.add(b32)
+                # streamed DEEP blocks regenerate their rate-L values
+                for nm, fn, args in ntt_kernel_specs(
+                    b32, log_n, L, mono=False
+                ):
+                    add(f"deep_regen:{nm}", fn, *args)
+        else:
+            per = max(1, P._DEEP_BLOCK_BUDGET // (N * 8))
+            for i in range(0, B, per):
+                deep_blocks.add(min(per, B - i))
+    per = max(1, P._DEEP_BLOCK_BUDGET // (N * 8))
+    for i in range(0, B_q, per):
+        deep_blocks.add(min(per, B_q - i))
+    for b in sorted(deep_blocks):
+        add(f"deep_block_b{b}", P._deep_block, _sds(b, N), _sds(b), _sds(b))
+    add("deep_combine", P._deep_combine, _sds(N), _sds(N),
+        _sds(B_all), _sds(B_all), _sds(B_all), _sds(B_all), pair(N))
+    extras = P._deep_extras_fn(2, num_lk, num_pi)
+    add(
+        "deep_extras", extras,
+        pair(N), _sds(2, N), _sds(2 * num_lk, N), _sds(num_pi, N),
+        pair(N), _sds(N) if lookups else _sds(1), _sds(num_pi, N),
+        pair(2), pair(num_lk), _sds(num_pi), _sds(2 + num_lk + num_pi),
+        _sds(2 + num_lk + num_pi),
+    )
+    for nm, fn, args in fri_kernel_specs(n, config):
+        add(nm, fn, *args)
+
+    # ---- cached domain tables (built once per geometry, but their batch
+    # inversions are real compiles on a cold cache) ------------------------
+    from ..field import goldilocks as gf
+    from .fri import fold_schedule
+
+    add("gf_binv_domain", gf.batch_inverse_xla, _sds(N))
+    num_folds = sum(
+        fold_schedule(
+            n, config.fri_final_degree,
+            getattr(config, "fri_folding_schedule", None),
+        )
+    )
+    log_full = N.bit_length() - 1
+    for r in range(num_folds):
+        add(
+            f"gf_binv_fold_r{r}", gf.batch_inverse_xla,
+            _sds(1 << (log_full - r - 1)),
+        )
+    if num_pi:
+        add("gf_binv_pi", gf.batch_inverse_xla, _sds(num_pi, N))
+
+    # dedupe identical (fn, args) pairs surfaced under several tags — one
+    # executable serves them all, compiling it twice is pure waste
+    seen = set()
+    out = []
+    for s in specs:
+        key = (id(s.fn), repr(s.args))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(s)
+    return out
+
+
+def precompile(
+    assembly,
+    config,
+    max_workers: int = 8,
+    ledger: CompileLedger | None = None,
+    lower_only: bool = False,
+) -> CompileLedger:
+    """Lower + compile the whole kernel library, overlapping the backend
+    compiles on a thread pool.
+
+    Tracing/lowering runs on the calling thread (it is Python work and
+    would only contend for the GIL); `.compile()` calls — blocking RPCs on
+    a tunneled backend — run on up to `max_workers` threads. Failures are
+    recorded per-kernel (ledger entry gains an "error" field) and never
+    abort the sweep: a kernel that fails to precompile simply compiles at
+    first dispatch like before. With `lower_only`, skips the backend
+    compile — used by tier-1 tests to validate the enumeration on CPU,
+    and still exercises every trace path."""
+    if ledger is None:
+        ledger = current_compile_ledger() or CompileLedger()
+    specs = enumerate_kernels(assembly, config)
+
+    lowered = []
+    for spec in specs:
+        t0 = time.perf_counter()
+        try:
+            low = spec.fn.lower(*spec.args)
+        except Exception as e:  # noqa: BLE001 - record and continue
+            ledger.record(
+                spec.name, time.perf_counter() - t0, 0.0, error=repr(e)
+            )
+            continue
+        lowered.append((spec, time.perf_counter() - t0, low))
+
+    if lower_only:
+        for spec, trace_s, _low in lowered:
+            ledger.record(spec.name, trace_s, 0.0, cache_hit=None)
+        return ledger
+
+    def _compile_one(item):
+        spec, trace_s, low = item
+        t0 = time.perf_counter()
+        try:
+            low.compile()
+        except Exception as e:  # noqa: BLE001
+            ledger.record(
+                spec.name, trace_s, time.perf_counter() - t0, error=repr(e)
+            )
+            return
+        dt = time.perf_counter() - t0
+        # sub-100ms "compiles" are persistent-cache loads in practice —
+        # a heuristic, but the ledger's monitoring counters carry the
+        # authoritative process-wide hit/miss totals
+        ledger.record(spec.name, trace_s, dt, cache_hit=dt < 0.1)
+
+    def _weight(item):
+        # schedule the biggest modules first: with K workers and a handful
+        # of minute-scale graphs among hundreds of second-scale ones, the
+        # makespan is set by whatever big graph starts LAST
+        _spec, _t, low = item
+        try:
+            return -len(low.as_text())
+        except Exception:
+            return 0
+
+    lowered.sort(key=_weight)
+    workers = max(1, min(max_workers, len(lowered) or 1))
+    # every sweep compile is already record()ed above — keep the ledger's
+    # log capture from double-counting them into dispatch_compiles
+    ledger.suppress_log_capture = True
+    try:
+        with ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(_compile_one, lowered))
+    finally:
+        ledger.suppress_log_capture = False
+    return ledger

@@ -85,7 +85,6 @@ class _StageClock:
             self._cm = None
 from .streaming import (
     MonomialSource,
-    commit_streaming,
     deep_source_blocks,
     use_streamed_lde,
 )
@@ -318,36 +317,48 @@ def _dev_cached(obj, name: str, build):
     return cache[name]
 
 
-@partial(jax.jit, static_argnums=(1, 2, 3))
-def _commit_fused(values, L: int, cap: int, stream: bool):
-    """values over H (B, n) -> (mono, lde | None, tree layers), ONE dispatch.
+def _commit_pipeline(values, L: int, cap: int, stream: bool):
+    """values over H (B, n) -> (mono, lde | None, tree layers).
 
+    The round-3 one-graph-per-commit form (`_commit_fused`) paid a 200 s+
+    remote compile per oracle SHAPE because the inverse NTT, the rate-L
+    forward NTTs, the leaf sponge and every node layer all landed in one
+    module. This issues the same math as a short pipeline of shape-keyed
+    top-level dispatches — inverse NTT keyed (B, n), LDE keyed (B, n, L),
+    leaf sponge keyed (B, L·n), node stack keyed only (L·n, cap) — each of
+    which compiles in well under a minute, precompiles concurrently
+    (prover/precompile.py), and is shared wherever the shape recurs (the
+    node stack is one executable for ALL oracles of a domain size).
     Streamed mode never materializes the rate-L storage: leaf digests are
-    absorbed per column block (streaming.streamed_leaf_digests)."""
-    from ..merkle import _node_layers, _tree_layers
-    from .streaming import streamed_leaf_digests
+    absorbed per column block (streaming.streamed_leaf_digests_blocks),
+    one reusable (COL_BLOCK, n) graph for every block of every oracle."""
+    from ..merkle import commit_layers_device, node_layers_device
+    from .streaming import streamed_leaf_digests_blocks
 
     mono = monomial_from_values(values)
     if stream:
-        return mono, None, _node_layers(streamed_leaf_digests(mono, L), cap)
+        digests = streamed_leaf_digests_blocks(mono, L)
+        return mono, None, node_layers_device(digests, cap)
     lde = lde_from_monomial(mono, L)
-    B = lde.shape[0]
-    return mono, lde, _tree_layers(lde.reshape(B, -1).T, cap)
+    return mono, lde, commit_layers_device(lde, cap)
 
 
 def _tree_from_layers(layers, cap):
     return MerkleTreeWithCap.from_layers(list(layers), cap)
 
 
-def _stage2_tail_fn(assembly, setup, L, cap, stream):
-    """Assembly-cached fused round-2 tail: z/partials + lookup A_i/B +
-    stacking + commit in one graph (inversions happen outside)."""
-    key = (L, cap, stream)
-    cached = getattr(assembly, "_stage2_tail_jit", None)
-    if cached is not None and cached[0] == key:
-        return cached[1]
-
-    from .stages import _z_and_partials
+def _stage2_stack_fn(assembly, selector_paths):
+    """Assembly-cached round-2 STACK graph: assemble the stage-2 column
+    stack [z | partials | lookup A_i | B] from the already-computed
+    z/partials and inverted lookup denominators — elementwise muls plus
+    one stack, a deliberately small compile. The round-3 form fused this
+    with `_z_and_partials` AND the full commit into one 163 s-compile
+    mega-graph; split, the prefix product, the stack and the commit
+    pipeline are separate shape-keyed dispatches (inversions happen
+    outside as ever)."""
+    cached = getattr(assembly, "_stage2_stack_jit", None)
+    if cached is not None:
+        return cached
 
     lookups = assembly.lookups_enabled
     lk_mode = assembly.lookup_mode
@@ -359,13 +370,12 @@ def _stage2_tail_fn(assembly, setup, L, cap, stream):
         )
     )
     if lookups and lk_mode == "general":
-        mk_path = tuple(setup.selector_paths[assembly.lookup_marker_gid()])
+        mk_path = tuple(selector_paths[assembly.lookup_marker_gid()])
     else:
         mk_path = None
 
     @jax.jit
-    def fn(num_all, den_inv_all, lk_inv, multiplicities, consts_dev):
-        z, partials_stacked = _z_and_partials(num_all, den_inv_all)
+    def fn(z, partials_stacked, lk_inv, multiplicities, consts_dev):
         stage2_list = [z[0], z[1]]
         for j in range(num_chunks - 1):
             stage2_list += [partials_stacked[0][j], partials_stacked[1][j]]
@@ -391,10 +401,9 @@ def _stage2_tail_fn(assembly, setup, L, cap, stream):
                 gf.mul(t_inv[0], multiplicities),
                 gf.mul(t_inv[1], multiplicities),
             ]
-        s2 = jnp.stack(stage2_list)
-        return _commit_fused(s2, L, cap, stream)
+        return jnp.stack(stage2_list)
 
-    assembly._stage2_tail_jit = (key, fn)
+    assembly._stage2_stack_jit = fn
     return fn
 
 
@@ -433,12 +442,14 @@ def _coset_eval_q(mono_stack, scale_q, c_arr):
     return _coset_eval(mono_stack, scale_row)
 
 
-def _coset_sweep_fn(assembly, setup, lk_ctx):
+def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
     """Assembly-cached fused per-coset quotient TERMS graph: gate sweep +
     copy-permutation + lookup terms + 1/Z_H over already-evaluated coset
     values (the 4 group evaluations run as separate _coset_eval_q
     dispatches). Reused across cosets AND proofs (challenges are array
-    args).
+    args). Takes selector paths + non-residues rather than the SetupData
+    so precompile.py can build (and warm) the very same assembly-cached
+    graph before the setup's sigma columns exist.
 
     The closure captures only structural data (gate sweep fn, counts,
     paths) — never the assembly/setup objects, so re-witnessed clones can
@@ -449,8 +460,7 @@ def _coset_sweep_fn(assembly, setup, lk_ctx):
 
     (lookups, lk_mode, R_args, width, num_partials, chunks,
      total_alpha_terms, Cg, Ct, W, K, M, mk_path) = lk_ctx
-    selector_paths = setup.selector_paths
-    non_residues = tuple(int(k) for k in setup.non_residues)
+    non_residues = tuple(int(k) for k in non_residues)
     from .stages import _build_gate_sweep
 
     total_gate_terms = num_gate_sweep_terms(assembly)
@@ -551,26 +561,22 @@ def _quotient_interp(T0_parts, T1_parts, Q: int, n: int):
     return jnp.stack(q_cols)
 
 
-@partial(jax.jit, static_argnums=(1,))
-def _commit_leaf_layers(lde, cap: int):
-    from ..merkle import _tree_layers
-
-    B = lde.shape[0]
-    return _tree_layers(lde.reshape(B, -1).T, cap)
-
-
 def _quotient_tail_fused(T0_parts, T1_parts, Q: int, n: int, L: int, cap: int):
     """Quotient interpolation + chunk split + LDE + commit.
 
-    Deliberately SEPARATE dispatches (interp / LDE / tree): at 2^20 rows
-    one fused graph's working set — the size-Q*n inverse transform, the
-    rate-L LDE, the leaf-major transpose and the tree layers with no dead-
-    buffer reuse between them — landed right at the device's memory
-    ceiling. Three extra launches cost ~30 ms; the freed intermediates are
-    GBs."""
+    Deliberately SEPARATE dispatches (interp / LDE / leaf sponge / node
+    stack): at 2^20 rows one fused graph's working set — the size-Q*n
+    inverse transform, the rate-L LDE, the leaf-major transpose and the
+    tree layers with no dead-buffer reuse between them — landed right at
+    the device's memory ceiling, and the merged module's remote compile
+    was part of the round-4 cold-start bill. The extra launches cost tens
+    of ms; the freed intermediates are GBs and the node stack shares its
+    executable with every other oracle (merkle.commit_layers_device)."""
+    from ..merkle import commit_layers_device
+
     q_mono = _quotient_interp(tuple(T0_parts), tuple(T1_parts), Q, n)
     q_lde = lde_from_monomial(q_mono, L)
-    return q_mono, q_lde, _commit_leaf_layers(q_lde, cap)
+    return q_mono, q_lde, commit_layers_device(q_lde, cap)
 
 
 @jax.jit
@@ -755,7 +761,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     total_cols = (Ct + W + M) + (Ct + K + TW) + S_est + 2 * Q_est
     stream = fused and use_streamed_lde(total_cols, N)
     if fused:
-        wit_mono, wit_lde, layers = _commit_fused(witness_cols, L, cap, stream)
+        wit_mono, wit_lde, layers = _commit_pipeline(
+            witness_cols, L, cap, stream
+        )
         wit_tree = _tree_from_layers(layers, cap)
     else:
         wit_mono = monomial_from_values(witness_cols)
@@ -836,15 +844,20 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 R_args, lp.width,
             )
             lk_inv = ext_f.batch_inverse(dens)
-        tail = _stage2_tail_fn(assembly, setup, L, cap, stream)
-        s2_mono, s2_lde, layers = tail(
-            num_all, den_inv_all, lk_inv, mult_dev, consts_dev
-        )
+        from .stages import _z_and_partials
+
+        z_pp = _z_and_partials(num_all, den_inv_all)
+        stack = _stage2_stack_fn(assembly, setup.selector_paths)
+        s2_vals = stack(z_pp[0], z_pp[1], lk_inv, mult_dev, consts_dev)
+        s2_mono, s2_lde, layers = _commit_pipeline(s2_vals, L, cap, stream)
+        del s2_vals
         s2_tree = _tree_from_layers(layers, cap)
-        # the chunk numerator/denominator ext stacks and lookup
-        # denominators total ~2 GB at 2^20 rows and are dead after the
-        # tail — rebind so the buffers free before the round-3 sweep
+        # the chunk numerator/denominator ext stacks, the z/partials and
+        # the lookup denominators total ~2 GB at 2^20 rows and are dead
+        # after the commit — rebind so the buffers free before the
+        # round-3 sweep
         num_all = den_all = den_inv_all = lk_inv = dens = mult_dev = None
+        z_pp = None
         if stream:
             # streamed proves regenerate everything from monomials; the
             # values-form device-input caches (witness columns, sigmas,
@@ -981,7 +994,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             total_alpha_terms, Cg, Ct, W, K, M,
             tuple(mk_path) if mk_path is not None else None,
         )
-        sweep = _coset_sweep_fn(assembly, setup, lk_ctx)
+        sweep = _coset_sweep_fn(
+            assembly, setup.selector_paths, setup.non_residues, lk_ctx
+        )
         import os as _os
 
         # At large traces each sweep execution's working set is a

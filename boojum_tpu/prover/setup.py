@@ -325,8 +325,15 @@ def generate_setup(assembly, config) -> SetupData:
         )
     else:
         lde = lde_from_monomial(monomials, config.fri_lde_factor)
-        leaves = lde.reshape(lde.shape[0], -1).T  # (lde*n, C+K)
-        tree = MerkleTreeWithCap(leaves, config.merkle_tree_cap_size)
+        # same shape-keyed leaf-sponge + node-stack dispatches as the
+        # prover's commit pipeline, so the setup commit shares executables
+        # (and the precompile warm) with the proof oracles
+        from ..merkle import commit_layers_device
+
+        tree = MerkleTreeWithCap.from_layers(
+            list(commit_layers_device(lde, config.merkle_tree_cap_size)),
+            config.merkle_tree_cap_size,
+        )
     vk = VerificationKey(
         geometry=assembly.geometry,
         trace_len=n,

@@ -55,37 +55,67 @@ def chunk_columns(num_cols: int, max_degree: int):
 @partial(jax.jit, static_argnums=(6,))
 def _all_chunk_num_den(copy_vals, sigma_vals, ks, xs, b, g, chunks):
     """Per-chunk products of numerator (w + β·k·x + γ) and denominator
-    (w + β·σ + γ), ALL chunks in one compiled graph -> (num_chunks, n)
-    stacked ext pairs. The denominator inversion happens OUTSIDE this jit:
-    batch_inverse must stay a top-level jit boundary — inlining its
-    Fermat-chain into larger XLA:CPU modules has produced never-terminating
-    executables on this backend (miscompile class, not a slowness issue)."""
-    nums0, nums1, dens0, dens1 = [], [], [], []
-    for chunk in chunks:
-        num_p = None
-        den_p = None
-        for col in chunk:
-            w = copy_vals[col]
-            kx = gf.mul(xs, ks[col])
+    (w + β·σ + γ), ALL chunks in one dispatch -> (num_chunks, n) stacked
+    ext pairs.
+
+    The loop over the uniform-width chunk prefix runs under `lax.scan`, so
+    the traced module holds ONE chunk's field ops instead of every chunk's
+    (the fully unrolled form's remote compile was 251 s on the 2^16 SHA
+    geometry — BASELINE.md round 4); a trailing ragged chunk unrolls into
+    the same graph. chunk_columns' chunks are contiguous column ranges, so
+    the blocked view is a reshape, never a gather. The denominator
+    inversion happens OUTSIDE this jit: batch_inverse must stay a
+    top-level jit boundary — inlining its Fermat-chain into larger
+    XLA:CPU modules has produced never-terminating executables on this
+    backend (miscompile class, not a slowness issue)."""
+    n = copy_vals.shape[-1]
+    flat = [col for c in chunks for col in c]
+    assert flat == list(range(len(flat))), chunks
+    w = len(chunks[0])
+    K_full = sum(1 for c in chunks if len(c) == w)
+    assert all(len(c) == w for c in chunks[:K_full]), chunks
+    assert len(chunks) - K_full <= 1, chunks
+
+    def _prod_terms(cv, sv, kv):
+        # cv/sv: (w', n) column blocks; kv: (w',) non-residues
+        num_p = den_p = None
+        for j in range(cv.shape[0]):
+            wcol = cv[j]
+            kx = gf.mul(xs, kv[j])
             num = (
-                gf.add(gf.add(w, gf.mul(kx, b[0])), g[0]),
+                gf.add(gf.add(wcol, gf.mul(kx, b[0])), g[0]),
                 gf.add(gf.mul(kx, b[1]), g[1]),
             )
-            s = sigma_vals[col]
+            s = sv[j]
             den = (
-                gf.add(gf.add(w, gf.mul(s, b[0])), g[0]),
+                gf.add(gf.add(wcol, gf.mul(s, b[0])), g[0]),
                 gf.add(gf.mul(s, b[1]), g[1]),
             )
             num_p = num if num_p is None else ext_f.mul(num_p, num)
             den_p = den if den_p is None else ext_f.mul(den_p, den)
-        nums0.append(num_p[0])
-        nums1.append(num_p[1])
-        dens0.append(den_p[0])
-        dens1.append(den_p[1])
-    return (
-        (jnp.stack(nums0), jnp.stack(nums1)),
-        (jnp.stack(dens0), jnp.stack(dens1)),
+        return num_p, den_p
+
+    def body(carry, blk):
+        num_p, den_p = _prod_terms(*blk)
+        return carry, (num_p[0], num_p[1], den_p[0], den_p[1])
+
+    Cw = K_full * w
+    _, (n0, n1, d0, d1) = jax.lax.scan(
+        body,
+        None,
+        (
+            copy_vals[:Cw].reshape(K_full, w, n),
+            sigma_vals[:Cw].reshape(K_full, w, n),
+            ks[:Cw].reshape(K_full, w),
+        ),
     )
+    if len(chunks) > K_full:
+        num_p, den_p = _prod_terms(copy_vals[Cw:], sigma_vals[Cw:], ks[Cw:])
+        n0 = jnp.concatenate([n0, num_p[0][None]])
+        n1 = jnp.concatenate([n1, num_p[1][None]])
+        d0 = jnp.concatenate([d0, den_p[0][None]])
+        d1 = jnp.concatenate([d1, den_p[1][None]])
+    return (n0, n1), (d0, d1)
 
 
 @jax.jit

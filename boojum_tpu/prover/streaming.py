@@ -122,10 +122,55 @@ def streamed_leaf_digests(mono, L: int):
     return state[:, :4]
 
 
+def streamed_leaf_digests_blocks(mono, L: int):
+    """Block-DISPATCHED form of streamed_leaf_digests: bit-identical
+    digests, but each COL_BLOCK column block is its own top-level jit
+    (one LDE + 4 carried-sponge absorbs) keyed only on (block, n, L) — so
+    the expensive NTT+Poseidon2 graph is compiled ONCE and reused across
+    every block of every streamed oracle, instead of re-tracing the whole
+    B-column absorb chain into each oracle's private mega-graph (the
+    round-3 `_commit_fused` compile bill, ISSUE 1). The per-block
+    dynamic_slice start rides as an array argument, so block index never
+    enters a cache key."""
+    assert COL_BLOCK % 8 == 0
+    n = mono.shape[-1]
+    B = mono.shape[0]
+    state = jnp.zeros((n * L, 12), jnp.uint64)
+    for i in range(0, B, COL_BLOCK):
+        b = min(COL_BLOCK, B - i)
+        blk = jax.lax.dynamic_slice_in_dim(mono, i, b, axis=0)
+        state = _absorb_lde_block(state, blk, L)
+    return state[:, :4]
+
+
+from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(2,))
+def _absorb_lde_block(state, mono_blk, L: int):
+    """Absorb one column block's rate-L values into the carried sponge
+    state: LDE-transform the (b, n) monomial block, transpose to rows and
+    absorb 8 columns at a time. A trailing partial chunk (only ever the
+    final block of an oracle — COL_BLOCK is a multiple of the sponge rate)
+    zero-pads per the sponge finalize rule, matching leaf_hash exactly."""
+    b = mono_blk.shape[0]
+    lde = lde_from_monomial(mono_blk, L)
+    cols = lde.reshape(b, -1).T  # (N, b)
+    for k in range(b // 8):
+        state = _sponge_absorb8(state, cols[:, 8 * k : 8 * k + 8])
+    rem = b % 8
+    if rem:
+        pad = jnp.zeros((cols.shape[0], 8 - rem), jnp.uint64)
+        state = _sponge_absorb8(
+            state, jnp.concatenate([cols[:, b - rem :], pad], axis=1)
+        )
+    return state
+
+
 def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:
     """Merkle-commit the rate-L LDE of `mono` without materializing it."""
     return MerkleTreeWithCap.from_digests(
-        streamed_leaf_digests(mono, L), cap_size
+        streamed_leaf_digests_blocks(mono, L), cap_size
     )
 
 

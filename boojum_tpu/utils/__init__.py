@@ -1,3 +1,19 @@
-from .profiling import stage_timer, profiling_enabled, log
+from .profiling import (
+    CompileLedger,
+    current_compile_ledger,
+    log,
+    profiling_enabled,
+    stage_timer,
+    start_compile_ledger,
+    stop_compile_ledger,
+)
 
-__all__ = ["stage_timer", "profiling_enabled", "log"]
+__all__ = [
+    "CompileLedger",
+    "current_compile_ledger",
+    "log",
+    "profiling_enabled",
+    "stage_timer",
+    "start_compile_ledger",
+    "stop_compile_ledger",
+]

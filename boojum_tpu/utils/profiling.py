@@ -7,13 +7,26 @@ reference src/lib.rs:80, used throughout prover.rs) and the `log!` macro
 wall-clock lines, enabled by BOOJUM_TPU_PROFILE=1 (or programmatically), and
 a `log` helper gated the same way. TPU-side kernel profiles come from
 `jax.profiler` traces (set BOOJUM_TPU_JAX_TRACE=<dir> around a prove call).
+
+Also home of the COMPILE LEDGER: per-graph trace/compile timings and
+persistent-cache hit/miss counts, fed from three sources — explicit
+`record()` calls (prover/precompile.py times every lower/compile itself),
+`jax.monitoring` duration/count events (backend_compile_duration, cache
+hits/misses), and, when `jax_log_compiles` is on, the per-graph
+"Finished XLA compilation of <name> in <t> sec" log lines that carry the
+only per-graph attribution jax exposes for compiles triggered by ordinary
+dispatch. bench.py emits the ledger as a JSON artifact so compile-bill
+regressions are visible in every round's output.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import logging
 import os
 import sys
+import threading
 import time
 
 _FORCED: bool | None = None
@@ -72,3 +85,250 @@ def stage_timer(name: str):
     if _STAGE_SINK is not None:
         _STAGE_SINK.append((name, dt))
     log(f"{name}: {dt:.3f}s")
+
+
+# ---------------------------------------------------------------------------
+# Compile ledger
+# ---------------------------------------------------------------------------
+
+# jax.monitoring event keys this ledger understands (jax 0.4.x):
+#   /jax/core/compile/backend_compile_duration        (duration)
+#   /jax/core/compile/jaxpr_trace_duration            (duration)
+#   /jax/compilation_cache/cache_hits                 (count)
+#   /jax/compilation_cache/cache_misses               (count)
+#   /jax/compilation_cache/compile_time_saved_sec     (duration)
+_DURATION_KEYS = (
+    "/jax/core/compile/backend_compile_duration",
+    "/jax/core/compile/jaxpr_trace_duration",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration",
+    "/jax/compilation_cache/compile_time_saved_sec",
+)
+_COUNT_KEYS = (
+    "/jax/compilation_cache/cache_hits",
+    "/jax/compilation_cache/cache_misses",
+)
+
+
+class CompileLedger:
+    """Per-graph compile accounting.
+
+    `entries` holds one dict per recorded kernel:
+      {name, trace_s, compile_s, cache_hit, ts}
+    appended under a lock so timestamps are monotonic in list order even
+    when compiles run on a thread pool. `events` aggregates the passive
+    jax.monitoring stream (whole-process durations/counts, no per-graph
+    names); `dispatch_compiles` collects the named per-graph compile times
+    parsed from jax's "Finished XLA compilation of <name>" log lines —
+    the only attribution available for graphs compiled by ordinary
+    dispatch rather than through precompile().
+
+    Caveat on that log line: jax emits it around compile_or_get_cached,
+    INCLUDING persistent-cache HITS — after a healthy precompile, a
+    prove's first dispatch of each kernel still logs one (fast) line for
+    the cache load. Parsed lines therefore split by elapsed time:
+    >= _DISPATCH_COMPILE_MIN_S lands in `dispatch_compiles`, smaller ones
+    are only counted/summed as cache loads in the summary. The split is a
+    heuristic — deserializing a BIG cached executable can also cross the
+    threshold — so treat `dispatch_compiles` as attribution (which graph,
+    when) and the monitoring `cache_misses` counter as the authoritative
+    did-anything-escape-the-precompiler signal: a prove that raises no
+    new misses compiled nothing, however slow its loads."""
+
+    # below this, a "Finished XLA compilation" line is a persistent-cache
+    # load, not a compile: loads are local-disk reads (well under a
+    # second) while even a cheap real compile on the tunneled service is
+    # a multi-second RPC
+    _DISPATCH_COMPILE_MIN_S = 1.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.entries: list[dict] = []
+        self.events: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+        self.dispatch_compiles: list[dict] = []
+        self._cache_loads = 0
+        self._cache_load_s = 0.0
+        # while the precompile sweep runs, its own .compile() calls also
+        # emit "Finished XLA compilation" log lines — suppressed here so
+        # dispatch_compiles only lists graphs that ESCAPED the library
+        # (the regression signal BASELINE.md documents), not every kernel
+        # counted twice
+        self.suppress_log_capture = False
+
+    # -- explicit source (precompile.py) ----------------------------------
+    def record(self, name: str, trace_s: float, compile_s: float,
+               cache_hit: bool | None = None, error: str | None = None):
+        with self._lock:
+            entry = {
+                "name": name,
+                "trace_s": round(float(trace_s), 4),
+                "compile_s": round(float(compile_s), 4),
+                "cache_hit": cache_hit,
+                "ts": round(time.monotonic() - self._t0, 4),
+            }
+            if error is not None:
+                entry["error"] = error
+            self.entries.append(entry)
+
+    # -- passive sources ---------------------------------------------------
+    def _on_duration(self, event: str, duration: float, **kw):
+        if event not in _DURATION_KEYS:
+            return
+        with self._lock:
+            self.events[event] = self.events.get(event, 0.0) + duration
+            self.counts[event] = self.counts.get(event, 0) + 1
+
+    def _on_event(self, event: str, **kw):
+        if event not in _COUNT_KEYS:
+            return
+        with self._lock:
+            self.counts[event] = self.counts.get(event, 0) + 1
+
+    def _on_log(self, record: logging.LogRecord):
+        if self.suppress_log_capture:
+            return
+        # dispatch.log_elapsed_time formats lazily; getMessage() renders
+        # "Finished XLA compilation of <fun_name> in <elapsed> sec"
+        try:
+            msg = record.getMessage()
+        except Exception:
+            return
+        marker = "Finished XLA compilation of "
+        if marker not in msg:
+            return
+        try:
+            rest = msg.split(marker, 1)[1]
+            name, _, tail = rest.rpartition(" in ")
+            secs = float(tail.split(" sec")[0])
+        except Exception:
+            return
+        with self._lock:
+            if secs < self._DISPATCH_COMPILE_MIN_S:
+                self._cache_loads += 1
+                self._cache_load_s += secs
+                return
+            self.dispatch_compiles.append({
+                "name": name,
+                "compile_s": round(secs, 4),
+                "ts": round(time.monotonic() - self._t0, 4),
+            })
+
+    # -- reporting ---------------------------------------------------------
+    def summary(self) -> dict:
+        with self._lock:
+            entries = list(self.entries)
+            dispatch = list(self.dispatch_compiles)
+            counts = dict(self.counts)
+            events = dict(self.events)
+            cache_loads = self._cache_loads
+            cache_load_s = self._cache_load_s
+        compile_total = sum(e["compile_s"] for e in entries)
+        worst = max(
+            entries + dispatch, key=lambda e: e["compile_s"], default=None
+        )
+        return {
+            "num_kernels": len(entries),
+            "precompile_total_s": round(compile_total, 3),
+            "num_dispatch_compiles": len(dispatch),
+            "dispatch_compile_total_s": round(
+                sum(e["compile_s"] for e in dispatch), 3
+            ),
+            "dispatch_cache_loads": cache_loads,
+            "dispatch_cache_load_s": round(cache_load_s, 3),
+            "worst_graph": None if worst is None else {
+                "name": worst["name"], "compile_s": worst["compile_s"]
+            },
+            "cache_hits": counts.get(
+                "/jax/compilation_cache/cache_hits", 0
+            ),
+            "cache_misses": counts.get(
+                "/jax/compilation_cache/cache_misses", 0
+            ),
+            "backend_compile_total_s": round(
+                events.get("/jax/core/compile/backend_compile_duration", 0.0),
+                3,
+            ),
+            "compile_time_saved_s": round(
+                events.get(
+                    "/jax/compilation_cache/compile_time_saved_sec", 0.0
+                ),
+                3,
+            ),
+        }
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            d = {
+                "entries": list(self.entries),
+                "dispatch_compiles": list(self.dispatch_compiles),
+                "monitoring_durations_s": {
+                    k: round(v, 3) for k, v in self.events.items()
+                },
+                "monitoring_counts": dict(self.counts),
+            }
+        d["summary"] = self.summary()
+        return d
+
+    def dump_json(self, path: str) -> dict:
+        d = self.to_dict()
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1)
+        return d
+
+
+_LEDGER: CompileLedger | None = None
+_LISTENERS_INSTALLED = False
+_LOG_HANDLER: logging.Handler | None = None
+
+
+class _LedgerLogHandler(logging.Handler):
+    def emit(self, record):
+        led = _LEDGER
+        if led is not None:
+            led._on_log(record)
+
+
+def start_compile_ledger(capture_logs: bool = True) -> CompileLedger:
+    """Install a fresh process-wide ledger and return it.
+
+    jax.monitoring offers no listener deregistration short of clearing ALL
+    listeners, so the listeners are installed once and route to whatever
+    ledger is current (no-ops when stopped). With `capture_logs`, a handler
+    on the jax dispatch/pxla loggers parses the per-graph compile lines;
+    pair it with jax.config jax_log_compiles=True (or JAX_LOG_COMPILES=1)
+    to get per-graph names for dispatch-time compiles."""
+    global _LEDGER, _LISTENERS_INSTALLED, _LOG_HANDLER
+    _LEDGER = CompileLedger()
+    if not _LISTENERS_INSTALLED:
+        try:
+            from jax import monitoring as _mon
+
+            _mon.register_event_duration_secs_listener(
+                lambda ev, dur, **kw: (
+                    _LEDGER._on_duration(ev, dur) if _LEDGER else None
+                )
+            )
+            _mon.register_event_listener(
+                lambda ev, **kw: (_LEDGER._on_event(ev) if _LEDGER else None)
+            )
+            _LISTENERS_INSTALLED = True
+        except Exception:
+            pass
+    if capture_logs and _LOG_HANDLER is None:
+        _LOG_HANDLER = _LedgerLogHandler(level=logging.DEBUG)
+        for name in ("jax._src.dispatch", "jax._src.interpreters.pxla"):
+            logging.getLogger(name).addHandler(_LOG_HANDLER)
+    return _LEDGER
+
+
+def current_compile_ledger() -> CompileLedger | None:
+    return _LEDGER
+
+
+def stop_compile_ledger() -> CompileLedger | None:
+    """Detach and return the current ledger (listeners become no-ops)."""
+    global _LEDGER
+    led = _LEDGER
+    _LEDGER = None
+    return led

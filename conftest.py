@@ -32,18 +32,17 @@ try:
     # them persistently so only the first-ever run pays. The dir is salted
     # with the host CPU fingerprint: XLA:CPU AOT entries embed the compile
     # machine's vector features and loading them on a different host
-    # segfaults (boojum_tpu/_hostfp.py has the full story). Loaded by file
-    # path so boojum_tpu/__init__'s jax-config side effects don't fire here.
-    import importlib.util as _ilu
+    # segfaults (boojum_tpu/_hostfp.py has the full story). Executed by
+    # file path (runpy) so boojum_tpu/__init__'s jax-config side effects
+    # don't fire here.
+    import runpy
 
     _root = os.path.dirname(os.path.abspath(__file__))
-    _spec = _ilu.spec_from_file_location(
-        "_bt_hostfp", os.path.join(_root, "boojum_tpu", "_hostfp.py")
-    )
-    _hostfp = _ilu.module_from_spec(_spec)
-    _spec.loader.exec_module(_hostfp)
+    _fp = runpy.run_path(
+        os.path.join(_root, "boojum_tpu", "_hostfp.py")
+    )["load_host_fingerprint"](_root)
 
-    _cache = os.path.join(_root, f".jax_cache-{_hostfp.host_fingerprint()}")
+    _cache = os.path.join(_root, f".jax_cache-{_fp}")
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

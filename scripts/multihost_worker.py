@@ -32,16 +32,15 @@ jax.config.update("jax_platforms", "cpu")
 xla_bridge._backend_factories.pop("axon", None)
 # same host-fingerprint-salted dir as conftest.py, and for the same reason
 # (cross-host XLA:CPU AOT entries segfault — see boojum_tpu/_hostfp.py);
-# sharing the name keeps the worker warm from test-suite compiles
-import importlib.util as _ilu
+# sharing the name keeps the worker warm from test-suite compiles. Executed
+# by file path (runpy) so boojum_tpu/__init__'s side effects don't fire.
+import runpy
 
 _root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_spec = _ilu.spec_from_file_location(
-    "_bt_hostfp", os.path.join(_root, "boojum_tpu", "_hostfp.py")
-)
-_hostfp = _ilu.module_from_spec(_spec)
-_spec.loader.exec_module(_hostfp)
-_cache = os.path.join(_root, f".jax_cache-{_hostfp.host_fingerprint()}")
+_fp = runpy.run_path(
+    os.path.join(_root, "boojum_tpu", "_hostfp.py")
+)["load_host_fingerprint"](_root)
+_cache = os.path.join(_root, f".jax_cache-{_fp}")
 jax.config.update("jax_compilation_cache_dir", _cache)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
