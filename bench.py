@@ -56,6 +56,17 @@ Environment knobs:
       compile_ledger.json next to this file)
   BENCH_LOG_COMPILES = 0 disables jax_log_compiles (on by default so the
       ledger can attribute dispatch-time compiles to graph names)
+  BOOJUM_TPU_REPORT = <path.jsonl> records every prove (warm-up + reps)
+      through the flight recorder and appends one labeled ProveReport
+      JSONL line each: hierarchical span tree, metrics (device memory,
+      transfer bytes, NTT/Merkle/FRI counts), Fiat–Shamir digest
+      checkpoints, compile-ledger summary. Inspect/diff with
+      scripts/prove_report.py (see BASELINE.md "Observability protocol").
+
+JSON line schema 2: adds "schema", promotes the per-stage split to every
+line (warm-up split until the first timed rep lands, so even a watchdog
+line carries one) and "peak_mem" (device high-water where the backend
+exposes memory_stats, live-buffer census bytes, host max RSS).
 """
 
 import json
@@ -200,11 +211,94 @@ _STATE = {
     "phase": "import",
     "reps": [],           # completed timed rep walls
     "warm_wall": None,    # warm-up (first, compile-laden) prove wall
-    "stages": {},         # per-stage split of the reported rep
+    "stages": {},         # per-stage split of the reported rep (the warm-up
+                          # split until the first timed rep lands, so EVERY
+                          # line — including the watchdog's — carries one)
+    "peak_mem": {},       # device/host memory high water, updated per prove
     "ntt_eps": None,
     "done": False,
 }
 _EMIT_LOCK = threading.Lock()
+
+# bench JSON line schema version. 2: stage split and peak_mem promoted to
+# every line (previously only present when the stage sink happened to be
+# installed), schema field added.
+_LINE_SCHEMA = 2
+
+# the LIVE stage sink of the prove currently in flight: the watchdog reads
+# it when _STATE["stages"] has no completed-prove split yet, so a line
+# fired MID-prove (the stuck-compile case schema 2 exists to diagnose)
+# still shows which stages finished before the stall
+_LIVE_SINK = {"sink": None}
+
+
+def _update_peak_mem():
+    """Fold current device/host memory high-water marks into _STATE
+    (best-effort: XLA:CPU exposes no device stats; ru_maxrss always
+    works on linux)."""
+    pm = dict(_STATE["peak_mem"])
+    try:
+        from boojum_tpu.utils import metrics as _metrics
+
+        dm = _metrics.device_memory_stats()
+        if dm:
+            for k in ("bytes_in_use", "peak_bytes_in_use"):
+                if k in dm:
+                    pm[f"device_{k}"] = max(pm.get(f"device_{k}", 0), dm[k])
+        census = _metrics.live_buffer_census()
+        if census is not None:
+            pm["live_buffer_bytes"] = max(
+                pm.get("live_buffer_bytes", 0), census[1]
+            )
+    except Exception:
+        pass
+    try:
+        import resource
+
+        pm["host_max_rss_kb"] = resource.getrusage(
+            resource.RUSAGE_SELF
+        ).ru_maxrss
+    except Exception:
+        pass
+    with _EMIT_LOCK:
+        if not _STATE["done"]:
+            _STATE["peak_mem"] = pm
+
+
+def _prove_recorded(label, fn):
+    """Run one prove; with BOOJUM_TPU_REPORT set, record it as a labeled
+    ProveReport JSONL line (span tree + metrics + digest checkpoints +
+    compile-ledger summary — utils/report.py)."""
+    path = os.environ.get("BOOJUM_TPU_REPORT")
+    if not path:
+        out = fn()
+        _update_peak_mem()
+        return out
+    from boojum_tpu.utils import report as _report
+
+    with _report.flight_recording(label=label) as rec:
+        try:
+            out = fn()
+        finally:
+            # a failed prove still leaves its (partial, error-annotated)
+            # report line — that is the diagnosable-timeout posture the
+            # watchdog/ledger already follow
+            _update_peak_mem()
+            try:
+                _report.append_jsonl(path, _report.build_report(rec))
+                _log(f"ProveReport line ({label}) appended to {path}")
+            except Exception as e:  # recorder must never sink the bench
+                _log(f"ProveReport write failed: {e!r}")
+    return out
+
+
+def _live_stage_split():
+    """Snapshot the in-flight prove's completed stages (empty when no
+    prove has started)."""
+    sink = _LIVE_SINK["sink"]
+    if not sink:
+        return {}
+    return {name: round(dt, 3) for name, dt in list(sink)}
 
 
 def _vs_baseline(value):
@@ -241,10 +335,12 @@ def _emit(status):
             "value": round(value, 4),
             "unit": "s",
             "vs_baseline": _vs_baseline(value),
+            "schema": _LINE_SCHEMA,
             "status": status,
             "phase": _STATE["phase"],
             "reps": [round(r, 4) for r in _STATE["reps"]],
-            "stages": _STATE["stages"],
+            "stages": _STATE["stages"] or _live_stage_split(),
+            "peak_mem": _STATE["peak_mem"],
         }
         if _STATE["ntt_eps"] is not None:
             out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
@@ -444,14 +540,20 @@ def main():
 
     # warm-up (compiles) then timed runs; report the MEDIAN rep and its
     # per-stage wall-clock split (the tunnel-attached device is noisy, so a
-    # single rep is not a number of record)
+    # single rep is not a number of record). The stage sink runs from the
+    # warm-up on, so every emitted line — including a watchdog line fired
+    # mid-warm-up — carries a stage split (schema 2).
     _STATE["phase"] = "warmup_prove"
     _log("warm-up prove (compiles on a cold cache)")
     for attempt in (1, 2):
+        sink = collect_stages()
+        _LIVE_SINK["sink"] = sink
         t0 = time.perf_counter()  # per-attempt: a failed attempt's stall
         # must not inflate the reported warm wall
         try:
-            proof = prove(asm, setup, config)
+            proof = _prove_recorded(
+                "warmup", lambda: prove(asm, setup, config)
+            )
             break
         except Exception as e:
             # the tunnel occasionally drops a big compile RPC; one retry
@@ -461,6 +563,9 @@ def main():
                 continue
             raise
     _STATE["warm_wall"] = round(time.perf_counter() - t0, 4)
+    with _EMIT_LOCK:
+        if not _STATE["done"]:
+            _STATE["stages"] = {name: round(dt, 3) for name, dt in sink}
     _log(f"warm-up prove done in {_STATE['warm_wall']}s; verifying")
     _STATE["phase"] = "verify"
     assert verify(setup.vk, proof, asm.gates)
@@ -469,8 +574,11 @@ def main():
     rep_stages = []
     for i in range(reps):
         sink = collect_stages()
+        _LIVE_SINK["sink"] = sink
         t0 = time.perf_counter()
-        proof = prove(asm, setup, config)
+        proof = _prove_recorded(
+            f"rep{i + 1}", lambda: prove(asm, setup, config)
+        )
         rep_wall = time.perf_counter() - t0
         rep_stages.append({name: round(dt, 3) for name, dt in sink})
         # update reps + the matching median split atomically wrt the

@@ -51,6 +51,11 @@ from .verifier import (
     split_alpha_powers,
     t_accumulator_at,
 )
+# flight-recorder digest checkpoints (no-op unless recording): the SAME
+# (round, label) stream as prover/prover.py, so a bit-parity break between
+# the TPU prover and this CPU reference localizes to the first diverging
+# Fiat–Shamir round via scripts/prove_report.py --diff
+from ..utils.report import checkpoint as _checkpoint
 
 ONE = ext.ONE_S
 ZERO = ext.ZERO_S
@@ -271,9 +276,11 @@ def prove_reference_dialect(
     # ---- transcript round 1: witness commit ------------------------------
     t = ReferenceTranscript()
     t.witness_merkle_tree_cap(setup_cap)
+    _checkpoint(0, "setup_cap", setup_cap)
     pi_values = [int(v) for (_c, _r, v) in assembly.public_inputs]
     for v in pi_values:
         t.witness_field_elements([v])
+    _checkpoint(0, "public_inputs", pi_values)
 
     host_cols = [np.asarray(assembly.copy_cols_values)]
     if Ct > Cg:
@@ -288,11 +295,15 @@ def prove_reference_dialect(
     wit_flat = _lde(wit_mono, L)
     wit_tree = MerkleTreeWithCap(jnp.asarray(wit_flat.T), cap_size)
     t.witness_merkle_tree_cap(wit_tree.get_cap())
+    _checkpoint(1, "witness_cap", wit_tree.get_cap())
     beta = (t.get_challenge(), t.get_challenge())
     gamma = (t.get_challenge(), t.get_challenge())
+    r1_challenges = [beta, gamma]
     if lookups:
         lookup_beta = (t.get_challenge(), t.get_challenge())
         lookup_gamma = (t.get_challenge(), t.get_challenge())
+        r1_challenges += [lookup_beta, lookup_gamma]
+    _checkpoint(1, "challenges", r1_challenges)
 
     # ---- stage 2: grand product + lookup polys (reference chunking) ------
     # z(w^{j+1}) = z(w^j) * prod_cols (v + b*x*nr + g)/(v + b*sigma + g);
@@ -403,7 +414,9 @@ def prove_reference_dialect(
     s2_flat = _lde(s2_mono, L)
     s2_tree = MerkleTreeWithCap(jnp.asarray(s2_flat.T), cap_size)
     t.witness_merkle_tree_cap(s2_tree.get_cap())
+    _checkpoint(2, "stage2_cap", s2_tree.get_cap())
     alpha = (t.get_challenge(), t.get_challenge())
+    _checkpoint(2, "alpha", alpha)
     challenges = split_alpha_powers(alpha, counts)
     challenges["beta"] = beta
     challenges["gamma"] = gamma
@@ -490,7 +503,9 @@ def prove_reference_dialect(
     q_flat = _lde(q_cols, L)
     q_tree = MerkleTreeWithCap(jnp.asarray(q_flat.T), cap_size)
     t.witness_merkle_tree_cap(q_tree.get_cap())
+    _checkpoint(3, "quotient_cap", q_tree.get_cap())
     z = (t.get_challenge(), t.get_challenge())
+    _checkpoint(3, "z", z)
 
     # ---- evaluations at z, z*omega, 0 ------------------------------------
     def ext_poly_at(base_idx, mono, at):
@@ -540,10 +555,14 @@ def prove_reference_dialect(
         t.witness_field_elements(v)
     for v in values_at_0:
         t.witness_field_elements(v)
+    _checkpoint(
+        4, "evaluations", [values_at_z, values_at_z_omega, values_at_0]
+    )
 
     # ---- DEEP ------------------------------------------------------------
     c0 = t.get_challenge()
     c1 = t.get_challenge()
+    _checkpoint(4, "deep_challenge", (c0, c1))
     public_input_opening_tuples = []
     for (col, row, value) in assembly.public_inputs:
         open_at = gl.pow_(omega, row)
@@ -694,8 +713,10 @@ def prove_reference_dialect(
         fri_trees.append(treeo)
         fri_caps.append(treeo.get_cap())
         t.witness_merkle_tree_cap(treeo.get_cap())
+        _checkpoint(5, f"fri_cap_{li}", treeo.get_cap())
         cc0 = t.get_challenge()
         cc1 = t.get_challenge()
+        _checkpoint(5, f"fri_challenge_{li}", (cc0, cc1))
         chs = [(cc0, cc1)]
         for _ in range(1, deg_log2):
             chs.append(e_mul(chs[-1], chs[-1]))
@@ -732,6 +753,13 @@ def prove_reference_dialect(
     )
     t.witness_field_elements(final_fri_monomials[0])
     t.witness_field_elements(final_fri_monomials[1])
+    # interleaved (c0, c1) pairs — the SAME encoding prover/fri.py digests
+    # (it checkpoints out.final_monomials, a list of pairs), so identical
+    # values give identical digests across the two implementations
+    _checkpoint(
+        5, "fri_final_monomials",
+        list(zip(final_fri_monomials[0], final_fri_monomials[1])),
+    )
 
     # ---- PoW (blake2s runner, pow.rs:93) ---------------------------------
     pow_challenge = 0
@@ -749,6 +777,7 @@ def prove_reference_dialect(
         t.witness_field_elements(
             [pow_challenge & 0xFFFFFFFF, pow_challenge >> 32]
         )
+    _checkpoint(5, "pow_nonce", [pow_challenge])
 
     # ---- queries ----------------------------------------------------------
     max_needed_bits = log_full
@@ -760,6 +789,7 @@ def prove_reference_dialect(
         for shift, bit in enumerate(bits):
             idx |= int(bool(bit)) << shift
         query_idxs.append(idx)
+    _checkpoint(5, "query_indices", query_idxs)
 
     def oracle_query(flat, treeo, idx):
         return {

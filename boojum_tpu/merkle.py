@@ -20,6 +20,7 @@ import numpy as np
 
 from .hashes.poseidon2 import leaf_hash, node_hash, Poseidon2SpongeHost
 from .parallel.sharding import host_np as _host_np
+from .utils import metrics as _metrics
 
 
 # Levels at or below this node count are fused into one compiled graph:
@@ -95,6 +96,7 @@ def node_layers_device(digests, cap_size: int):
 def commit_layers_device(lde_cols, cap_size: int):
     """Column stack -> digest layers (leaves first, cap last) as two
     shape-keyed dispatches: leaf sponge + shared node stack."""
+    _metrics.count("merkle.commit_layer_builds")
     return node_layers_device(leaf_digests_device(lde_cols), cap_size)
 
 
@@ -116,6 +118,7 @@ class MerkleTreeWithCap:
         assert self.num_leaves & (self.num_leaves - 1) == 0, "leaf count must be 2^k"
         assert self.num_leaves >= cap_size
         self.cap_size = cap_size
+        _metrics.count("merkle.tree_builds")
         self.layers = list(_tree_layers(leaf_values, cap_size))
         self._cap_host = [
             tuple(int(x) for x in row) for row in _host_np(self.layers[-1])
@@ -134,6 +137,7 @@ class MerkleTreeWithCap:
         assert cap_size & (cap_size - 1) == 0 and n >= cap_size
         tree.cap_size = cap_size
         tree.num_leaves = n
+        _metrics.count("merkle.tree_builds")
         tree.layers = list(node_layers_device(digests, cap_size))
         tree._cap_host = [
             tuple(int(x) for x in row) for row in _host_np(tree.layers[-1])
@@ -147,6 +151,7 @@ class MerkleTreeWithCap:
         tree = cls.__new__(cls)
         tree.cap_size = cap_size
         tree.num_leaves = int(layers[0].shape[0])
+        _metrics.count("merkle.tree_builds")
         tree.layers = list(layers)
         tree._cap_host = [
             tuple(int(x) for x in row) for row in _host_np(layers[-1])

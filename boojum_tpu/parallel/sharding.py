@@ -27,6 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..field import gl
 from ..field import goldilocks as gf
 from ..field import extension as ext_f
+from ..utils import metrics as _metrics
 # the explicitly-XLA sponge entry points: this module's arrays carry
 # NamedShardings for GSPMD to partition, which pallas_call cannot split
 from ..hashes.poseidon2 import leaf_hash_xla as leaf_hash
@@ -263,16 +264,25 @@ def host_np(x):
     """np.asarray that also works for MULTI-PROCESS global arrays: a
     sharded jax.Array spanning non-addressable devices cannot be fetched
     directly (jax raises), so gather it to every host first. Single-process
-    (and plain numpy/host values) pass straight through."""
+    (and plain numpy/host values) pass straight through.
+
+    This is the prover's one device->host seam, so the flight recorder's
+    d2h transfer counter lives here (no-op without a metrics registry)."""
+    was_device = isinstance(x, jax.Array)
     try:
         if (
-            isinstance(x, jax.Array)
+            was_device
             and jax.process_count() > 1
             and not x.is_fully_addressable
         ):
             from jax.experimental import multihost_utils
 
-            return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            out = np.asarray(multihost_utils.process_allgather(x, tiled=True))
+            _metrics.count_bytes_d2h(out.nbytes)
+            return out
     except Exception:
         pass
-    return np.asarray(x)
+    out = np.asarray(x)
+    if was_device:
+        _metrics.count_bytes_d2h(out.nbytes)
+    return out

@@ -21,6 +21,9 @@ from ..field import gl
 from ..field import extension as ext_f
 from ..field import goldilocks as gf
 from ..merkle import MerkleTreeWithCap
+from ..utils import metrics as _metrics
+from ..utils.report import checkpoint as _checkpoint
+from ..utils.spans import span as _span
 from ..ntt import (
     bitreverse_indices,
     get_ntt_context,
@@ -237,42 +240,52 @@ def fri_prove(
 
     cur = codeword
     fold_round = 0
-    for k in schedule:
-        if fused:
-            layers = _fri_commit_fn(k, config.merkle_tree_cap_size)(*cur)
-            tree = MerkleTreeWithCap.from_layers(
-                list(layers), config.merkle_tree_cap_size
-            )
-        else:
-            tree = commit_codeword(
-                cur, config.merkle_tree_cap_size, elems_per_leaf=1 << k
-            )
-        out.trees.append(tree)
-        out.values.append(cur)
-        transcript.witness_merkle_tree_cap(tree.get_cap())
-        ch = transcript.get_ext_challenge()
-        out.challenges.append(ch)
-        if fused:
-            ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
-            cur = _fri_fold_fn(k)(
-                cur[0], cur[1], ch01,
-                tuple(tables[fold_round : fold_round + k]),
-            )
-            fold_round += k
-        else:
-            sub = ch
-            for _ in range(k):
-                cur = fold_once(cur, sub, tables[fold_round])
-                fold_round += 1
-                sub = ext_f.sqr_s(sub)
+    for r, k in enumerate(schedule):
+        with _span(f"fri_oracle_{r}", k=k):
+            if fused:
+                layers = _fri_commit_fn(k, config.merkle_tree_cap_size)(*cur)
+                tree = MerkleTreeWithCap.from_layers(
+                    list(layers), config.merkle_tree_cap_size
+                )
+            else:
+                tree = commit_codeword(
+                    cur, config.merkle_tree_cap_size, elems_per_leaf=1 << k
+                )
+            _metrics.count("fri.oracle_commits")
+            out.trees.append(tree)
+            out.values.append(cur)
+            transcript.witness_merkle_tree_cap(tree.get_cap())
+            _checkpoint(5, f"fri_cap_{r}", tree.get_cap())
+            ch = transcript.get_ext_challenge()
+            _checkpoint(5, f"fri_challenge_{r}", ch)
+            out.challenges.append(ch)
+            _metrics.count("fri.folds", k)
+            if fused:
+                ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
+                cur = _fri_fold_fn(k)(
+                    cur[0], cur[1], ch01,
+                    tuple(tables[fold_round : fold_round + k]),
+                )
+                fold_round += k
+            else:
+                sub = ch
+                for _ in range(k):
+                    cur = fold_once(cur, sub, tables[fold_round])
+                    fold_round += 1
+                    sub = ext_f.sqr_s(sub)
     # final interpolation over coset g^(2^R)·H_{N>>R}
     n_fin = N >> num_folds
     shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
-    if fused:
-        mono0, mono1 = _fri_final_fused(cur[0], cur[1], shift_inv)
-    else:
-        mono0 = distribute_powers(ifft_bitreversed_to_natural(cur[0]), shift_inv)
-        mono1 = distribute_powers(ifft_bitreversed_to_natural(cur[1]), shift_inv)
+    with _span("fri_final_interpolation"):
+        if fused:
+            mono0, mono1 = _fri_final_fused(cur[0], cur[1], shift_inv)
+        else:
+            mono0 = distribute_powers(
+                ifft_bitreversed_to_natural(cur[0]), shift_inv
+            )
+            mono1 = distribute_powers(
+                ifft_bitreversed_to_natural(cur[1]), shift_inv
+            )
     from ..parallel.sharding import host_np
 
     m0 = host_np(mono0)
@@ -284,6 +297,7 @@ def fri_prove(
     out.final_monomials = [(int(a), int(b)) for a, b in zip(m0[:deg_bound], m1[:deg_bound])]
     for c0, c1 in out.final_monomials:
         transcript.witness_field_elements([c0, c1])
+    _checkpoint(5, "fri_final_monomials", out.final_monomials)
     out.num_folds = num_folds
     return out
 

@@ -38,7 +38,9 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from ..utils import metrics as _metrics
 from ..utils.profiling import CompileLedger, current_compile_ledger
+from ..utils.spans import span as _span
 
 
 def _sds(*shape):
@@ -325,19 +327,23 @@ def precompile(
     and still exercises every trace path."""
     if ledger is None:
         ledger = current_compile_ledger() or CompileLedger()
-    specs = enumerate_kernels(assembly, config)
+    with _span("precompile_enumerate"):
+        specs = enumerate_kernels(assembly, config)
+    _metrics.count("precompile.kernels", len(specs))
 
     lowered = []
-    for spec in specs:
-        t0 = time.perf_counter()
-        try:
-            low = spec.fn.lower(*spec.args)
-        except Exception as e:  # noqa: BLE001 - record and continue
-            ledger.record(
-                spec.name, time.perf_counter() - t0, 0.0, error=repr(e)
-            )
-            continue
-        lowered.append((spec, time.perf_counter() - t0, low))
+    with _span("precompile_lower", kernels=len(specs)):
+        for spec in specs:
+            t0 = time.perf_counter()
+            try:
+                low = spec.fn.lower(*spec.args)
+            except Exception as e:  # noqa: BLE001 - record and continue
+                ledger.record(
+                    spec.name, time.perf_counter() - t0, 0.0, error=repr(e)
+                )
+                _metrics.count("precompile.lower_errors")
+                continue
+            lowered.append((spec, time.perf_counter() - t0, low))
 
     if lower_only:
         for spec, trace_s, _low in lowered:
@@ -353,6 +359,7 @@ def precompile(
             ledger.record(
                 spec.name, trace_s, time.perf_counter() - t0, error=repr(e)
             )
+            _metrics.count("precompile.compile_errors")
             return
         dt = time.perf_counter() - t0
         # sub-100ms "compiles" are persistent-cache loads in practice —
@@ -363,12 +370,27 @@ def precompile(
     def _weight(item):
         # schedule the biggest modules first: with K workers and a handful
         # of minute-scale graphs among hundreds of second-scale ones, the
-        # makespan is set by whatever big graph starts LAST
-        _spec, _t, low = item
-        try:
-            return -len(low.as_text())
-        except Exception:
-            return 0
+        # makespan is set by whatever big graph starts LAST. Total input
+        # bytes (from the ShapeDtypeStruct args already in hand) is the
+        # proxy — rendering every module's MLIR text (len(low.as_text()))
+        # ranked similarly but cost multi-MB transient strings and seconds
+        # of serial Python on the cold-start path this sweep exists to
+        # shorten.
+        spec, _t, _low = item
+
+        def arg_bytes(a):
+            if isinstance(a, (tuple, list)):
+                return sum(arg_bytes(x) for x in a)
+            shape = getattr(a, "shape", None)
+            if shape is None:
+                return 0
+            n = 1
+            for d in shape:
+                n *= int(d)
+            itemsize = getattr(getattr(a, "dtype", None), "itemsize", 8)
+            return n * itemsize
+
+        return -arg_bytes(spec.args)
 
     lowered.sort(key=_weight)
     workers = max(1, min(max_workers, len(lowered) or 1))
@@ -376,8 +398,9 @@ def precompile(
     # log capture from double-counting them into dispatch_compiles
     ledger.suppress_log_capture = True
     try:
-        with ThreadPoolExecutor(max_workers=workers) as pool:
-            list(pool.map(_compile_one, lowered))
+        with _span("precompile_compile_pool", workers=workers):
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                list(pool.map(_compile_one, lowered))
     finally:
         ledger.suppress_log_capture = False
     return ledger

@@ -50,39 +50,54 @@ from .config import ProofConfig
 from .fri import fri_prove
 from .pow import pow_grind
 from .proof import OracleQuery, Proof, SingleRoundQueries
-from ..utils import stage_timer
+from ..utils import metrics as _metrics
+from ..utils.report import checkpoint as _checkpoint
+from ..utils.spans import span as _span
+from ..utils.spans import sync_point as _sync_point
 
 
 class _StageClock:
-    """Sequential stage timing with guaranteed cleanup: prove() wraps its
+    """Sequential stage spans with guaranteed cleanup: prove() wraps its
     body in try/finally so an exception mid-stage still closes the open
-    stage_timer (incl. any jax.profiler annotation)."""
+    span (incl. any jax.profiler annotation), recording the partial stage
+    with an `error` field instead of dropping it. Each stage start also
+    takes a metrics boundary snapshot (live-buffer census + device memory
+    high water) when a registry is installed."""
 
     def __init__(self):
         self._cm = None
 
     def start(self, name):
         self.stop()
+        _metrics.stage_boundary(name)
         import os
 
         if os.environ.get("BOOJUM_TPU_MEMLOG"):
             import sys
 
-            live = jax.live_arrays()
-            total = sum(a.size * a.dtype.itemsize for a in live)
-            print(
-                f"[boojum_tpu mem] before {name}: {total / 2**30:.2f} GiB "
-                f"({len(live)} arrays)",
-                file=sys.stderr,
-                flush=True,
-            )
-        self._cm = stage_timer(name)
+            census = _metrics.live_buffer_census()
+            if census is not None:
+                num, total = census
+                print(
+                    f"[boojum_tpu mem] before {name}: "
+                    f"{total / 2**30:.2f} GiB ({num} arrays)",
+                    file=sys.stderr,
+                    flush=True,
+                )
+        self._cm = _span(name, stage=True)
         self._cm.__enter__()
 
-    def stop(self):
-        if self._cm is not None:
-            self._cm.__exit__(None, None, None)
-            self._cm = None
+    def stop(self, error: BaseException | None = None):
+        if self._cm is None:
+            return
+        cm, self._cm = self._cm, None
+        if error is None:
+            cm.__exit__(None, None, None)
+            return
+        try:
+            cm.__exit__(type(error), error, error.__traceback__)
+        except BaseException:
+            pass  # span recorded the error; the caller re-raises it
 from .streaming import (
     MonomialSource,
     deep_source_blocks,
@@ -138,6 +153,7 @@ def _deep_main_sum(lde_sources, y0s, y1s, c0s, c1s, inv_xz):
     t0 = None
     t1 = None
     for blk, off in deep_source_blocks(lde_sources, _DEEP_BLOCK_BUDGET):
+        _metrics.count("deep.blocks")
         j = off + blk.shape[0]
         b0, b1 = _deep_block(blk, c0s[off:j], c1s[off:j])
         t0 = b0 if t0 is None else gf.add(t0, b0)
@@ -304,21 +320,35 @@ def _dev_cached(obj, name: str, build):
     import os
 
     if os.environ.get("BOOJUM_TPU_CACHE_DEVICE_INPUTS", "").strip() == "0":
-        return build()
+        return _count_upload(build())
     cache = getattr(obj, "_dev_cache", None)
     if cache is None:
         cache = {}
         try:
             obj._dev_cache = cache
         except Exception:
-            return build()
+            return _count_upload(build())
     if name not in cache:
-        cache[name] = build()
+        cache[name] = _count_upload(build())
     return cache[name]
+
+
+def _count_upload(x):
+    """Tally a fresh host->device upload into the metrics registry (no-op
+    without one); cache hits in _dev_cached never reach this."""
+    if _metrics.current_registry() is not None:
+        try:
+            _metrics.count_bytes_h2d(int(x.size) * x.dtype.itemsize)
+        except Exception:
+            pass
+    return x
 
 
 def _commit_pipeline(values, L: int, cap: int, stream: bool):
     """values over H (B, n) -> (mono, lde | None, tree layers).
+
+    (Flight recorder: one `commit_pipeline` span per oracle, NTT/Merkle
+    invocation counters — no-ops unless recording.)
 
     The round-3 one-graph-per-commit form (`_commit_fused`) paid a 200 s+
     remote compile per oracle SHAPE because the inverse NTT, the rate-L
@@ -335,12 +365,17 @@ def _commit_pipeline(values, L: int, cap: int, stream: bool):
     from ..merkle import commit_layers_device, node_layers_device
     from .streaming import streamed_leaf_digests_blocks
 
-    mono = monomial_from_values(values)
-    if stream:
-        digests = streamed_leaf_digests_blocks(mono, L)
-        return mono, None, node_layers_device(digests, cap)
-    lde = lde_from_monomial(mono, L)
-    return mono, lde, commit_layers_device(lde, cap)
+    with _span("commit_pipeline", stream=stream):
+        mono = monomial_from_values(values)
+        _metrics.count("ntt.monomial_from_values")
+        if stream:
+            digests = streamed_leaf_digests_blocks(mono, L)
+            _metrics.count("merkle.streamed_commits")
+            return mono, None, node_layers_device(digests, cap)
+        lde = lde_from_monomial(mono, L)
+        _metrics.count("ntt.lde_from_monomial")
+        _metrics.count("merkle.commits")
+        return mono, lde, commit_layers_device(lde, cap)
 
 
 def _tree_from_layers(layers, cap):
@@ -685,17 +720,54 @@ def _stream_gather_fused(mono, idx_dev, L: int):
 def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     """Prove; with `mesh` (a jax.sharding.Mesh from parallel.make_mesh) the
     polynomial work shards over the mesh ('col' axis for per-column phases,
-    both axes for leaf hashing) and produces a byte-identical proof."""
+    both axes for leaf hashing) and produces a byte-identical proof.
+
+    Flight recorder: with BOOJUM_TPU_REPORT=<path> each prove records
+    hierarchical spans, metrics and Fiat–Shamir digest checkpoints and
+    appends one ProveReport JSONL line to <path> (utils/report.py). A
+    caller that already installed a FlightRecorder (bench.py labels its
+    reps) keeps ownership — no double emission."""
+    import os
+
+    from ..utils import report as _report
+
+    path = os.environ.get("BOOJUM_TPU_REPORT")
+    if path and _report.current_flight_recorder() is None:
+        with _report.flight_recording(
+            label=f"prove_n{assembly.trace_len}"
+        ) as rec:
+            try:
+                return _prove_entry(assembly, setup, config, mesh)
+            finally:
+                # emit even when the prove raised — the partial span tree
+                # (with its error field) and the checkpoints up to the
+                # failure are exactly what a post-mortem needs
+                try:
+                    _report.append_jsonl(path, _report.build_report(rec))
+                except Exception as e:  # noqa: BLE001 — the recorder must
+                    # never turn a successful prove into a crash
+                    from ..utils.profiling import log
+
+                    log(f"ProveReport write to {path!r} failed: {e!r}")
+    return _prove_entry(assembly, setup, config, mesh)
+
+
+def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:
     from ..parallel.sharding import prover_mesh
 
     clock = _StageClock()
-    try:
-        if mesh is not None:
-            with prover_mesh(mesh):
-                return _prove_impl(assembly, setup, config, clock)
-        return _prove_impl(assembly, setup, config, clock)
-    finally:
-        clock.stop()
+    _metrics.count("prover.proves")
+    with _span("prove", trace_len=assembly.trace_len):
+        try:
+            if mesh is not None:
+                with prover_mesh(mesh):
+                    return _prove_impl(assembly, setup, config, clock)
+            return _prove_impl(assembly, setup, config, clock)
+        except BaseException as e:
+            clock.stop(error=e)
+            raise
+        finally:
+            clock.stop()
 
 
 def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
@@ -723,8 +795,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     t = make_transcript(setup.vk.transcript)
     t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
+    _checkpoint(0, "setup_cap", setup.vk.setup_merkle_cap)
     pi_values = [v for (_c, _r, v) in assembly.public_inputs]
     t.witness_field_elements(pi_values)
+    _checkpoint(0, "public_inputs", pi_values)
 
     # ---- round 1: witness commitment -------------------------------------
     clock.start("round1_witness_commit")
@@ -771,11 +845,15 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         wit_tree, _ = _commit_columns(wit_lde, cap)
     del witness_cols  # values over H: monomials carry them from here
     t.witness_merkle_tree_cap(wit_tree.get_cap())
+    _checkpoint(1, "witness_cap", wit_tree.get_cap())
     beta = t.get_ext_challenge()
     gamma = t.get_ext_challenge()
+    r1_challenges = [beta, gamma]
     if lookups:
         lookup_beta = t.get_ext_challenge()
         lookup_gamma = t.get_ext_challenge()
+        r1_challenges += [lookup_beta, lookup_gamma]
+    _checkpoint(1, "challenges", r1_challenges)
 
     # ---- round 2: copy-permutation + lookup stage 2 ----------------------
     clock.start("round2_stage2_commit")
@@ -804,12 +882,14 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             return jnp.asarray(np.array([s[0], s[1]], dtype=np.uint64))
 
         beta01, gamma01 = _pair(beta), _pair(gamma)
-        num_all, den_all = _all_chunk_num_den(
-            copy_vals, sigma_dev, ks, xs_h,
-            (beta01[0], beta01[1]), (gamma01[0], gamma01[1]),
-            tuple(tuple(c) for c in chunks),
-        )
-        den_inv_all = ext_f.batch_inverse(den_all)
+        with _span("stage2_chunk_num_den"):
+            num_all, den_all = _all_chunk_num_den(
+                copy_vals, sigma_dev, ks, xs_h,
+                (beta01[0], beta01[1]), (gamma01[0], gamma01[1]),
+                tuple(tuple(c) for c in chunks),
+            )
+            den_inv_all = ext_f.batch_inverse(den_all)
+        _metrics.count("stage2.chunk_scans")
         lk_inv = mult_dev = consts_dev = None
         lkb01 = lkg01 = None
         if lookups:
@@ -924,7 +1004,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         s2_tree, _ = _commit_columns(s2_lde, cap)
     del copy_vals, sigma_dev  # round 3 reads sigmas from the setup monomials
     t.witness_merkle_tree_cap(s2_tree.get_cap())
+    _checkpoint(2, "stage2_cap", s2_tree.get_cap())
     alpha = t.get_ext_challenge()
+    _checkpoint(2, "alpha", alpha)
 
     # ---- round 3: quotient (streamed per coset at rate Q) ----------------
     # The sweep runs over Q = vk.quotient_degree cosets while every oracle
@@ -1021,6 +1103,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         T_parts0, T_parts1 = [], []
         for c in range(Q):
             ci = jnp.int32(c)
+            _metrics.count("ntt.coset_evals", 4)
+            _metrics.count("quotient.coset_sweeps")
             wit_v = _coset_eval_q(wit_mono, scale_q, ci)
             setup_v = _coset_eval_q(setup.setup_monomials, scale_q, ci)
             s2_v = _coset_eval_q(s2_mono, scale_q, ci)
@@ -1036,6 +1120,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 jax.block_until_ready(t1c)
             T_parts0.append(t0c)
             T_parts1.append(t1c)
+        _sync_point(T_parts1, "round3_sweeps")
         q_mono, q_lde, layers = _quotient_tail_fused(
             tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
         )
@@ -1124,7 +1209,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         q_lde = lde_from_monomial(q_mono, L)
         q_tree, _ = _commit_columns(q_lde, cap)
     t.witness_merkle_tree_cap(q_tree.get_cap())
+    _checkpoint(3, "quotient_cap", q_tree.get_cap())
     z_chal = t.get_ext_challenge()
+    _checkpoint(3, "z", z_chal)
 
     # ---- round 4: evaluations at z (and z*omega, 0) ----------------------
     clock.start("round4_evaluations")
@@ -1165,7 +1252,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         t.witness_field_elements(v)
     for v in values_at_0:
         t.witness_field_elements(v)
+    _checkpoint(
+        4, "evaluations", [values_at_z, values_at_z_omega, values_at_0]
+    )
     deep_ch = t.get_ext_challenge()
+    _checkpoint(4, "deep_challenge", deep_ch)
 
     # ---- round 5: DEEP + FRI ---------------------------------------------
     clock.start("round5_deep_fri")
@@ -1298,8 +1389,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 term_base = gf.mul(num, denoms[k])
                 h = ext_f.add(h, (gf.mul(term_base, ch[0]), gf.mul(term_base, ch[1])))
 
+    _sync_point(h, "deep_codeword")
     fri = fri_prove(h, t, config, base_degree=n, fused=fused)
     pow_nonce = pow_grind(t, config.pow_bits)
+    _checkpoint(5, "pow_nonce", [pow_nonce])
 
     # ---- queries ----------------------------------------------------------
     clock.start("queries")
@@ -1309,6 +1402,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # storage / per tree level instead of per-query element reads — the
     # round-trips dominate when the device sits behind a network tunnel
     idxs = [bs.get_index(t, log_full) for _ in range(config.num_queries)]
+    _checkpoint(5, "query_indices", idxs)
     idx_dev = jnp.asarray(np.array(idxs, dtype=np.int64))
 
     # PLAN every query gather (leaf rows + all tree path levels, all
@@ -1375,9 +1469,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ONE fused gather dispatch + ONE host transfer
     arrs_, idxs_, axes_ = zip(*plans)
-    flat = host_np(
-        _gather_flat_fused(tuple(arrs_), tuple(idxs_), tuple(axes_))
-    )
+    _metrics.count("query.gather_plans", len(plans))
+    with _span("query_gather"):
+        flat = host_np(
+            _gather_flat_fused(tuple(arrs_), tuple(idxs_), tuple(axes_))
+        )
     _plan_offsets = np.concatenate(
         [[0], np.cumsum([int(np.prod(s)) for s in plan_shapes])]
     )

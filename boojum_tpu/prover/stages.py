@@ -34,6 +34,8 @@ from ..ntt import (
 )
 from ..cs.field_like import ArrayOps
 from ..cs.gates.base import RowView, TermsCollector
+from ..utils import metrics as _metrics
+from ..utils.spans import span as _span
 
 
 def ext_scalar(s):
@@ -192,13 +194,15 @@ def compute_copy_permutation_stage2(
     chunks = chunk_columns(C, max_degree)
     ks = jnp.asarray(np.array([int(k) for k in non_residues], dtype=np.uint64))
 
-    num_all, den_all = _all_chunk_num_den(
-        copy_vals, sigma_vals, ks, xs, b, g,
-        tuple(tuple(c) for c in chunks),
-    )
-    # ONE stacked inversion for every chunk denominator
-    den_inv_all = ext_f.batch_inverse(den_all)
-    z, partials_stacked = _z_and_partials(num_all, den_inv_all)
+    _metrics.count("stage2.chunk_scans")
+    with _span("stage2_grand_product"):
+        num_all, den_all = _all_chunk_num_den(
+            copy_vals, sigma_vals, ks, xs, b, g,
+            tuple(tuple(c) for c in chunks),
+        )
+        # ONE stacked inversion for every chunk denominator
+        den_inv_all = ext_f.batch_inverse(den_all)
+        z, partials_stacked = _z_and_partials(num_all, den_inv_all)
     partials = [
         (partials_stacked[0][j], partials_stacked[1][j])
         for j in range(len(chunks) - 1)
@@ -325,6 +329,8 @@ def gate_terms_contribution(
 def _build_gate_sweep(gates, selector_paths, geometry):
     from ..cs.gate_capture import packed_program_for, scan_evaluate
 
+    _metrics.count("gate_sweep.builds")
+
     def core(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1):
         t = 0
         acc = None
@@ -412,6 +418,7 @@ def compute_lookup_polys(
     b = ext_scalar(lookup_beta)
     g = ext_scalar(lookup_gamma)
     R = int(num_repetitions)
+    _metrics.count("stage2.lookup_denominator_builds")
     dens = _lookup_denominators(
         lookup_cols, table_id_col, table_cols, b, g, R, int(width),
     )

@@ -1,0 +1,191 @@
+"""Prover metrics registry — the flight recorder's counter/gauge axis.
+
+Counters (host↔device transfer bytes, NTT/Merkle/FRI invocation counts)
+and gauges (device-memory high water, live-buffer census) accumulated
+alongside the span tree. The module-level helpers (`count`, `gauge_max`,
+`stage_boundary`) are no-op-cheap when no registry is installed — one
+global read and a None check — so the prover keeps them threaded through
+its hot path permanently.
+
+Memory sources, best-effort by design:
+- `device.memory_stats()` (bytes_in_use / peak_bytes_in_use) where the
+  backend exposes it (TPU does; XLA:CPU usually returns None) — guarded,
+  absent keys are simply omitted from the report.
+- `jax.live_arrays()` census (count + total bytes) — works on every
+  backend and is what the old BOOJUM_TPU_MEMLOG printed; here it lands in
+  per-stage `boundaries` entries so HBM growth is attributable to a stage.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0 = time.perf_counter()
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self.boundaries: list[dict] = []
+
+    def count(self, name: str, n: int = 1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def gauge_set(self, name: str, v: float):
+        with self._lock:
+            self.gauges[name] = v
+
+    def gauge_max(self, name: str, v: float):
+        with self._lock:
+            if v > self.gauges.get(name, float("-inf")):
+                self.gauges[name] = v
+
+    def boundary(self, label: str):
+        """Record a stage-boundary snapshot: live-buffer census plus (when
+        the backend exposes it) device memory stats; also folds the peak
+        readings into gauges so the report's summary carries high-water
+        marks without walking the boundary list."""
+        entry: dict = {
+            "label": label,
+            "t_s": round(time.perf_counter() - self._t0, 4),
+        }
+        census = live_buffer_census()
+        if census is not None:
+            entry["live_arrays"], entry["live_bytes"] = census
+            self.gauge_max("mem.live_bytes_high_water", census[1])
+        dm = device_memory_stats()
+        if dm:
+            entry["device_memory"] = dm
+            peak = dm.get("peak_bytes_in_use")
+            if peak is not None:
+                self.gauge_max("mem.device_peak_bytes_in_use", peak)
+            in_use = dm.get("bytes_in_use")
+            if in_use is not None:
+                self.gauge_max("mem.device_bytes_in_use_high_water", in_use)
+        with self._lock:
+            self.boundaries.append(entry)
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            return {
+                "counters": dict(sorted(self.counters.items())),
+                "gauges": {
+                    k: round(float(v), 4)
+                    for k, v in sorted(self.gauges.items())
+                },
+                "boundaries": list(self.boundaries),
+            }
+
+
+_REGISTRY: MetricsRegistry | None = None
+
+
+def current_registry() -> MetricsRegistry | None:
+    return _REGISTRY
+
+
+def install_registry(reg: MetricsRegistry | None) -> MetricsRegistry | None:
+    global _REGISTRY
+    prev = _REGISTRY
+    _REGISTRY = reg
+    return prev
+
+
+def start_metrics() -> MetricsRegistry:
+    reg = MetricsRegistry()
+    install_registry(reg)
+    return reg
+
+
+def stop_metrics() -> MetricsRegistry | None:
+    return install_registry(None)
+
+
+# -- no-op-cheap module-level recording hooks --------------------------------
+
+
+def count(name: str, n: int = 1):
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count(name, n)
+
+
+def gauge_max(name: str, v: float):
+    reg = _REGISTRY
+    if reg is not None:
+        reg.gauge_max(name, v)
+
+
+def count_bytes_h2d(nbytes: int):
+    """Host->device upload accounting (counted at the prover's explicit
+    upload seams; transfers inside compiled graphs are invisible here)."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count("transfer.h2d_bytes", nbytes)
+        reg.count("transfer.h2d_ops")
+
+
+def count_bytes_d2h(nbytes: int):
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count("transfer.d2h_bytes", nbytes)
+        reg.count("transfer.d2h_ops")
+
+
+def stage_boundary(label: str):
+    reg = _REGISTRY
+    if reg is not None:
+        reg.boundary(label)
+
+
+# -- memory probes -----------------------------------------------------------
+
+
+def live_buffer_census() -> tuple[int, int] | None:
+    """(num_live_arrays, total_bytes) over jax.live_arrays(), or None when
+    jax is unavailable."""
+    try:
+        import jax
+
+        live = jax.live_arrays()
+        return len(live), int(
+            sum(a.size * a.dtype.itemsize for a in live)
+        )
+    except Exception:
+        return None
+
+
+def device_memory_stats() -> dict | None:
+    """Aggregated device.memory_stats() over local devices: sums
+    bytes_in_use, maxes peak_bytes_in_use. None/{} when the backend does
+    not expose stats (XLA:CPU)."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return None
+    in_use = 0
+    peak = 0
+    seen = False
+    kinds = set()
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        seen = True
+        kinds.add(getattr(d, "device_kind", str(d.platform)))
+        in_use += int(stats.get("bytes_in_use", 0))
+        peak = max(peak, int(stats.get("peak_bytes_in_use", 0)))
+    if not seen:
+        return None
+    out = {"bytes_in_use": in_use, "device_kinds": sorted(kinds)}
+    if peak:
+        out["peak_bytes_in_use"] = peak
+    return out

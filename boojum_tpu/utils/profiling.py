@@ -44,9 +44,41 @@ def set_profiling(on: bool | None):
     _FORCED = on
 
 
+class _GatedStderrHandler(logging.Handler):
+    """stderr handler gated on BOOJUM_TPU_PROFILE (kept out of the stream
+    when profiling is off) that resolves sys.stderr at EMIT time, so
+    redirected/captured stderr (tests, bench wrappers) still sees the
+    lines."""
+
+    def emit(self, record):
+        if not profiling_enabled():
+            return
+        try:
+            print(self.format(record), file=sys.stderr, flush=True)
+        except Exception:
+            pass
+
+
+logger = logging.getLogger("boojum_tpu")
+if not any(isinstance(h, _GatedStderrHandler) for h in logger.handlers):
+    _handler = _GatedStderrHandler()
+    _handler.setFormatter(logging.Formatter("[boojum_tpu] %(message)s"))
+    logger.addHandler(_handler)
+    logger.setLevel(logging.INFO)
+    # quiet by default: per-stage INFO records must not leak into an
+    # application's root handlers (propagation skips ancestor LOGGER
+    # levels, so a plain basicConfig() would otherwise print every stage
+    # line even with profiling off). Handlers attached directly to the
+    # "boojum_tpu" logger still receive everything; an embedder that wants
+    # the records in its root pipeline flips propagate back on.
+    logger.propagate = False
+
+
 def log(msg: str):
-    if profiling_enabled():
-        print(f"[boojum_tpu] {msg}", file=sys.stderr, flush=True)
+    """Library log line. Routed through logging.getLogger("boojum_tpu") so
+    user handlers ON THAT LOGGER compose; the built-in stderr handler only
+    prints under BOOJUM_TPU_PROFILE=1, preserving the quiet default."""
+    logger.info(msg)
 
 
 _STAGE_SINK: list | None = None
@@ -67,24 +99,15 @@ def stop_collecting_stages():
 
 @contextlib.contextmanager
 def stage_timer(name: str):
-    """Wall-clock a prover stage; also opens a jax.profiler trace context
-    when BOOJUM_TPU_JAX_TRACE points at a directory."""
-    trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
-    if not profiling_enabled() and not trace_dir and _STAGE_SINK is None:
-        yield
-        return
-    ctx = contextlib.nullcontext()
-    if trace_dir:
-        import jax
+    """Wall-clock a prover stage. Now a thin shim over the hierarchical
+    span recorder (utils/spans.py): same flat sink/log behavior as before,
+    plus tree recording when a SpanRecorder is installed, plus exception
+    safety — a stage that raises still records its timing (with an
+    `error` field on the span) instead of losing the line."""
+    from .spans import span
 
-        ctx = jax.profiler.TraceAnnotation(name)
-    t0 = time.perf_counter()
-    with ctx:
+    with span(name, stage=True):
         yield
-    dt = time.perf_counter() - t0
-    if _STAGE_SINK is not None:
-        _STAGE_SINK.append((name, dt))
-    log(f"{name}: {dt:.3f}s")
 
 
 # ---------------------------------------------------------------------------

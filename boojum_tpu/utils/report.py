@@ -1,0 +1,504 @@
+"""ProveReport: the flight recorder's versioned JSONL artifact.
+
+One report line per prove:
+  {kind, schema, label, unix_ts, wall_s,
+   spans:      [hierarchical span tree, utils/spans.py],
+   metrics:    {counters, gauges, boundaries}, (utils/metrics.py),
+   checkpoints:[{seq, round, label, digest}, ...]  — Fiat–Shamir state,
+   compile_ledger: summary (when a CompileLedger is installed),
+   host:       {platform, process_index}}
+
+Transcript DIGEST CHECKPOINTS are the parity-triage axis: at every
+Fiat–Shamir round the prover records blake2s(canonical LE64 encoding) of
+what crossed the transcript — per-stage Merkle caps, drawn challenges, FRI
+fold challenges, final monomials, query indices. Two proves of the same
+witness produce byte-identical checkpoint streams; a bit-parity break
+against compat/prove_reference.py (or a past report) localizes to the
+FIRST diverging (round, label) instead of the final proof blob.
+
+This module is intentionally stdlib-only at import time: the report CLI
+(scripts/prove_report.py) loads it standalone — without importing
+boojum_tpu (and therefore jax) — for render/diff/check of existing
+artifacts. The recording entry points import spans/metrics lazily.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import time
+
+REPORT_KIND = "boojum_tpu.prove_report"
+REPORT_SCHEMA = 1
+
+# canonical Fiat–Shamir round order; validation checks checkpoint rounds
+# never decrease along the stream
+ROUND_ORDER = (0, 1, 2, 3, 4, 5)
+
+
+def _flatten_ints(values):
+    out = []
+    stack = [values]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(reversed(v))
+        else:
+            out.append(int(v))
+    return out
+
+
+def digest_of(values) -> str:
+    """blake2s over the 8-byte little-endian words of the (possibly
+    nested) integer sequence — the canonical checkpoint digest."""
+    h = hashlib.blake2s()
+    for v in _flatten_ints(values):
+        h.update((v & ((1 << 64) - 1)).to_bytes(8, "little"))
+    return h.hexdigest()
+
+
+class CheckpointLog:
+    def __init__(self):
+        self.entries: list[dict] = []
+
+    def add(self, round_: int, label: str, values):
+        self.entries.append(
+            {
+                "seq": len(self.entries),
+                "round": int(round_),
+                "label": label,
+                "digest": digest_of(values),
+            }
+        )
+
+
+_CHECKPOINTS: CheckpointLog | None = None
+
+
+def current_checkpoint_log() -> CheckpointLog | None:
+    return _CHECKPOINTS
+
+
+def install_checkpoint_log(log: CheckpointLog | None):
+    global _CHECKPOINTS
+    prev = _CHECKPOINTS
+    _CHECKPOINTS = log
+    return prev
+
+
+def checkpoint(round_: int, label: str, values):
+    """Record one Fiat–Shamir digest checkpoint; no-op-cheap (one global
+    read) when nothing is recording."""
+    log = _CHECKPOINTS
+    if log is not None:
+        log.add(round_, label, values)
+
+
+# ---------------------------------------------------------------------------
+# Flight recording: spans + metrics + checkpoints as one unit
+# ---------------------------------------------------------------------------
+
+
+class FlightRecorder:
+    """Bundles the three collectors for one recorded prove."""
+
+    def __init__(self, label: str | None = None, sync: bool = True):
+        from . import metrics as _metrics
+        from . import spans as _spans
+
+        self.label = label
+        self.spans = _spans.SpanRecorder(sync=sync)
+        self.metrics = _metrics.MetricsRegistry()
+        self.checkpoints = CheckpointLog()
+        self._t0 = time.perf_counter()
+        self.wall_s: float | None = None
+
+    def close(self):
+        if self.wall_s is None:
+            self.wall_s = round(time.perf_counter() - self._t0, 6)
+
+
+_FLIGHT: FlightRecorder | None = None
+
+
+def current_flight_recorder() -> FlightRecorder | None:
+    return _FLIGHT
+
+
+@contextlib.contextmanager
+def flight_recording(label: str | None = None, sync: bool = True):
+    """Install a FlightRecorder (spans + metrics + checkpoints) for the
+    duration of the block; restores whatever was installed before."""
+    global _FLIGHT
+    from . import metrics as _metrics
+    from . import spans as _spans
+
+    rec = FlightRecorder(label=label, sync=sync)
+    prev_flight = _FLIGHT
+    _FLIGHT = rec
+    prev_spans = _spans.install_recorder(rec.spans)
+    prev_metrics = _metrics.install_registry(rec.metrics)
+    prev_ckpt = install_checkpoint_log(rec.checkpoints)
+    try:
+        yield rec
+    finally:
+        rec.close()
+        _spans.install_recorder(prev_spans)
+        _metrics.install_registry(prev_metrics)
+        install_checkpoint_log(prev_ckpt)
+        _FLIGHT = prev_flight
+
+
+def build_report(rec: FlightRecorder, extra: dict | None = None) -> dict:
+    rec.close()
+    d: dict = {
+        "kind": REPORT_KIND,
+        "schema": REPORT_SCHEMA,
+        "label": rec.label,
+        "unix_ts": round(time.time(), 3),
+        "wall_s": rec.wall_s,
+        "spans": rec.spans.tree(),
+        "metrics": rec.metrics.to_dict(),
+        "checkpoints": list(rec.checkpoints.entries),
+    }
+    try:
+        from .profiling import current_compile_ledger
+
+        ledger = current_compile_ledger()
+        if ledger is not None:
+            d["compile_ledger"] = ledger.summary()
+    except Exception:
+        pass
+    try:
+        import jax
+
+        d["host"] = {
+            "platform": jax.default_backend(),
+            "process_index": jax.process_index(),
+        }
+    except Exception:
+        pass
+    if extra:
+        d.update(extra)
+    return d
+
+
+def append_jsonl(path: str, report: dict):
+    line = json.dumps(report, separators=(",", ":"))
+    with open(path, "a") as f:
+        f.write(line + "\n")
+
+
+def load_reports(path: str) -> list[dict]:
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+def load_report(path: str, index: int = -1) -> dict:
+    reports = load_reports(path)
+    if not reports:
+        raise ValueError(f"{path}: no report lines")
+    return reports[index]
+
+
+# ---------------------------------------------------------------------------
+# Validation / analysis (pure dict functions — usable standalone)
+# ---------------------------------------------------------------------------
+
+
+def _walk_spans(spans, prefix=()):
+    """Yield (path_tuple, span) depth-first."""
+    for sp in spans:
+        path = prefix + (sp.get("name", "?"),)
+        yield path, sp
+        yield from _walk_spans(sp.get("children", ()), path)
+
+
+def flatten_spans(report: dict) -> list[tuple[str, dict]]:
+    return [
+        ("/".join(path), sp)
+        for path, sp in _walk_spans(report.get("spans", ()))
+    ]
+
+
+def span_coverage(report: dict) -> float:
+    """Fraction of the root prove span's wall covered by its direct
+    children (the stage spans). 0.0 when there is no usable tree."""
+    spans = report.get("spans") or []
+    root = next((s for s in spans if s.get("name") == "prove"), None)
+    if root is None and spans:
+        root = spans[0]
+    if not root or not root.get("wall_s"):
+        return 0.0
+    covered = sum(
+        c.get("wall_s") or 0.0 for c in root.get("children", ())
+    )
+    return min(1.0, covered / root["wall_s"])
+
+
+def validate_report(report: dict) -> list[str]:
+    """Schema + monotonicity checks; returns a list of problems (empty =
+    valid). This is the `prove_report.py --check` gate."""
+    problems: list[str] = []
+    if report.get("kind") != REPORT_KIND:
+        problems.append(f"kind is {report.get('kind')!r}, want {REPORT_KIND!r}")
+    if report.get("schema") != REPORT_SCHEMA:
+        problems.append(
+            f"schema is {report.get('schema')!r}, want {REPORT_SCHEMA}"
+        )
+    wall = report.get("wall_s")
+    if not isinstance(wall, (int, float)) or wall < 0:
+        problems.append(f"wall_s invalid: {wall!r}")
+    for path, sp in _walk_spans(report.get("spans", ())):
+        w = sp.get("wall_s")
+        if not isinstance(w, (int, float)) or w < 0:
+            problems.append(f"span {'/'.join(path)}: wall_s invalid: {w!r}")
+        st = sp.get("start_s")
+        if not isinstance(st, (int, float)) or st < 0:
+            problems.append(f"span {'/'.join(path)}: start_s invalid: {st!r}")
+        for c in sp.get("children", ()):
+            cst = c.get("start_s")
+            if (
+                isinstance(cst, (int, float))
+                and isinstance(st, (int, float))
+                and cst + 1e-6 < st
+            ):
+                problems.append(
+                    f"span {'/'.join(path)}: child {c.get('name')!r} starts "
+                    f"before its parent"
+                )
+    ckpts = report.get("checkpoints")
+    if not isinstance(ckpts, list):
+        problems.append("checkpoints missing")
+        ckpts = []
+    last_seq = -1
+    last_round = -1
+    seen_labels = set()
+    for e in ckpts:
+        seq, rnd, label = e.get("seq"), e.get("round"), e.get("label")
+        dg = e.get("digest")
+        if not isinstance(seq, int) or seq <= last_seq:
+            problems.append(f"checkpoint {label!r}: seq {seq!r} not increasing")
+        else:
+            last_seq = seq
+        if not isinstance(rnd, int) or rnd < last_round:
+            problems.append(
+                f"checkpoint {label!r}: round {rnd!r} decreases "
+                f"(after round {last_round})"
+            )
+        else:
+            last_round = rnd
+        if (rnd, label) in seen_labels:
+            problems.append(f"checkpoint {label!r}: duplicate in round {rnd}")
+        seen_labels.add((rnd, label))
+        if not (
+            isinstance(dg, str)
+            and len(dg) == 64
+            and all(c in "0123456789abcdef" for c in dg)
+        ):
+            problems.append(f"checkpoint {label!r}: digest malformed: {dg!r}")
+    metrics = report.get("metrics")
+    if not isinstance(metrics, dict) or "counters" not in metrics:
+        problems.append("metrics missing or malformed")
+    return problems
+
+
+def diff_reports(a: dict, b: dict, top: int = 10) -> dict:
+    """Regression-triage diff: per-span wall deltas (matched by tree path,
+    repeated paths summed) and the FIRST diverging digest checkpoint."""
+
+    def _span_walls(report):
+        walls: dict[str, float] = {}
+        for path, sp in flatten_spans(report):
+            walls[path] = walls.get(path, 0.0) + (sp.get("wall_s") or 0.0)
+        return walls
+
+    wa, wb = _span_walls(a), _span_walls(b)
+    deltas = []
+    for path in sorted(set(wa) | set(wb)):
+        va, vb = wa.get(path), wb.get(path)
+        deltas.append(
+            {
+                "span": path,
+                "a_s": None if va is None else round(va, 6),
+                "b_s": None if vb is None else round(vb, 6),
+                "delta_s": (
+                    None
+                    if va is None or vb is None
+                    else round(vb - va, 6)
+                ),
+            }
+        )
+    # real deltas first (largest |delta| on top); spans present in only one
+    # report sort LAST — they must never crowd genuine regressions out of
+    # the top-N window
+    deltas.sort(
+        key=lambda d: (
+            d["delta_s"] is None,
+            -abs(d["delta_s"]) if d["delta_s"] is not None else 0.0,
+        )
+    )
+
+    ca = a.get("checkpoints") or []
+    cb = b.get("checkpoints") or []
+    first_div = None
+    for ea, eb in zip(ca, cb):
+        if (
+            ea.get("label") != eb.get("label")
+            or ea.get("round") != eb.get("round")
+            or ea.get("digest") != eb.get("digest")
+        ):
+            first_div = {
+                "seq": ea.get("seq"),
+                "round": ea.get("round"),
+                "label": ea.get("label"),
+                "a_digest": ea.get("digest"),
+                "b_digest": eb.get("digest"),
+                "b_label": eb.get("label"),
+            }
+            break
+    if first_div is None and len(ca) != len(cb):
+        longer = ca if len(ca) > len(cb) else cb
+        e = longer[min(len(ca), len(cb))]
+        first_div = {
+            "seq": e.get("seq"),
+            "round": e.get("round"),
+            "label": e.get("label"),
+            "a_digest": e.get("digest") if len(ca) > len(cb) else None,
+            "b_digest": e.get("digest") if len(cb) > len(ca) else None,
+            "length_mismatch": [len(ca), len(cb)],
+        }
+
+    def _counters(r):
+        return (r.get("metrics") or {}).get("counters") or {}
+
+    na, nb = _counters(a), _counters(b)
+    counter_deltas = {
+        k: [na.get(k), nb.get(k)]
+        for k in sorted(set(na) | set(nb))
+        if na.get(k) != nb.get(k)
+    }
+    return {
+        "wall_a_s": a.get("wall_s"),
+        "wall_b_s": b.get("wall_s"),
+        "span_deltas": deltas[:top],
+        "first_checkpoint_divergence": first_div,
+        "num_checkpoints": [len(ca), len(cb)],
+        "counter_deltas": counter_deltas,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+
+
+def render_report(report: dict, top: int = 10) -> str:
+    lines = []
+    wall = report.get("wall_s") or 0.0
+    lines.append(
+        f"ProveReport schema={report.get('schema')} "
+        f"label={report.get('label')!r} wall={wall:.3f}s "
+        f"coverage={span_coverage(report) * 100:.1f}%"
+    )
+    spans = report.get("spans") or []
+
+    def _emit(sp, depth):
+        w = sp.get("wall_s") or 0.0
+        pct = f"{100 * w / wall:5.1f}%" if wall else "     "
+        extras = ""
+        if sp.get("sync_s"):
+            extras += f" sync={sp['sync_s']:.3f}s"
+        if sp.get("error"):
+            extras += f" ERROR={sp['error']!r}"
+        lines.append(
+            f"  {'  ' * depth}{sp.get('name'):<{max(4, 40 - 2 * depth)}}"
+            f"{w:9.3f}s {pct}{extras}"
+        )
+        for c in sp.get("children", ()):
+            _emit(c, depth + 1)
+
+    for sp in spans:
+        _emit(sp, 0)
+
+    flat = [
+        (path, sp.get("wall_s") or 0.0)
+        for path, sp in flatten_spans(report)
+        if not sp.get("children")
+    ]
+    flat.sort(key=lambda t: -t[1])
+    if flat:
+        lines.append(f"  top {min(top, len(flat))} leaf spans:")
+        for path, w in flat[:top]:
+            lines.append(f"    {w:9.3f}s  {path}")
+
+    counters = (report.get("metrics") or {}).get("counters") or {}
+    if counters:
+        lines.append("  counters:")
+        for k, v in counters.items():
+            lines.append(f"    {k} = {v}")
+    gauges = (report.get("metrics") or {}).get("gauges") or {}
+    if gauges:
+        lines.append("  gauges:")
+        for k, v in gauges.items():
+            lines.append(f"    {k} = {v}")
+    ckpts = report.get("checkpoints") or []
+    lines.append(f"  checkpoints: {len(ckpts)}")
+    for e in ckpts:
+        lines.append(
+            f"    [{e.get('seq'):>3}] r{e.get('round')} "
+            f"{e.get('label'):<28} {str(e.get('digest'))[:16]}…"
+        )
+    ledger = report.get("compile_ledger")
+    if ledger:
+        lines.append(
+            f"  compile ledger: {ledger.get('num_kernels')} kernels, "
+            f"precompile {ledger.get('precompile_total_s')}s, "
+            f"{ledger.get('num_dispatch_compiles')} dispatch compiles"
+        )
+    return "\n".join(lines)
+
+
+def render_diff(diff: dict) -> str:
+    lines = [
+        f"wall: {diff.get('wall_a_s')}s -> {diff.get('wall_b_s')}s",
+        f"checkpoints: {diff['num_checkpoints'][0]} vs "
+        f"{diff['num_checkpoints'][1]}",
+    ]
+    fd = diff.get("first_checkpoint_divergence")
+    if fd is None:
+        lines.append("digest checkpoints: IDENTICAL (no divergence)")
+    else:
+        lines.append(
+            f"FIRST DIVERGING CHECKPOINT: seq={fd.get('seq')} "
+            f"round={fd.get('round')} label={fd.get('label')!r}"
+        )
+        lines.append(
+            f"  a={fd.get('a_digest')}\n  b={fd.get('b_digest')}"
+        )
+        if fd.get("length_mismatch"):
+            lines.append(f"  (length mismatch: {fd['length_mismatch']})")
+    lines.append("span wall deltas (top by |delta|):")
+    for d in diff.get("span_deltas", ()):
+        a = "-" if d["a_s"] is None else f"{d['a_s']:.3f}"
+        b = "-" if d["b_s"] is None else f"{d['b_s']:.3f}"
+        dl = "-" if d["delta_s"] is None else f"{d['delta_s']:+.3f}"
+        lines.append(f"  {dl:>10}s  {a:>9} -> {b:<9}  {d['span']}")
+    if diff.get("counter_deltas"):
+        lines.append("counter deltas:")
+        for k, (a, b) in diff["counter_deltas"].items():
+            lines.append(f"  {k}: {a} -> {b}")
+    return "\n".join(lines)
+
+
+def default_report_path() -> str | None:
+    """The BOOJUM_TPU_REPORT env target (None = reporting off)."""
+    p = os.environ.get("BOOJUM_TPU_REPORT")
+    return p or None

@@ -1,0 +1,205 @@
+"""Hierarchical prover spans — the flight recorder's time axis.
+
+Counterpart of the reference's scoped `firestorm` profiling macros
+(`profile_fn!/profile_section!`, reference src/lib.rs:80): where the old
+`stage_timer` emitted a FLAT per-stage wall-clock list, `span()` records a
+parent/child TREE. Every span carries wall time, start offset, optional
+attributes, an `error` field when its body raised (partial spans are
+recorded, never lost), and — when BOOJUM_TPU_JAX_TRACE points at a
+directory — a `jax.profiler.TraceAnnotation` so device traces carry the
+same names.
+
+Recording is opt-in: with no `SpanRecorder` installed and profiling off,
+`span()` is a handful of attribute reads and one `os.environ.get` — cheap
+enough to leave threaded through every prover stage permanently. Stage
+spans (``stage=True``) additionally feed the legacy flat stage sink
+(`profiling.collect_stages`) and the per-stage stderr log line, so
+`bench.py`'s stage split keeps working unchanged.
+
+Explicit device sync points: `sync_point(x, label)` calls
+`jax.block_until_ready` when an installed recorder asks for synced spans,
+charging asynchronously-dispatched device work to the stage that issued it
+instead of whichever later stage first touches the result.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+
+from . import profiling as _prof
+
+
+class SpanRecorder:
+    """Collects a span tree. Spans opened on the installing thread nest via
+    a per-thread stack; spans opened from other threads (e.g. the
+    precompile pool) become additional roots of that thread's own tree and
+    are merged into `roots` on close."""
+
+    def __init__(self, sync: bool = True):
+        self.t0 = time.perf_counter()
+        self.roots: list[dict] = []
+        self.sync = sync
+        self._tls = threading.local()
+        self._lock = threading.Lock()
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current(self) -> dict | None:
+        st = self._stack()
+        return st[-1] if st else None
+
+    def open(self, name: str, **attrs) -> dict:
+        now = time.perf_counter()
+        sp: dict = {
+            "name": name,
+            "start_s": round(now - self.t0, 6),
+            "wall_s": None,
+            "children": [],
+        }
+        if attrs:
+            sp["attrs"] = dict(attrs)
+        st = self._stack()
+        if st:
+            st[-1]["children"].append(sp)
+        else:
+            with self._lock:
+                self.roots.append(sp)
+        st.append(sp)
+        sp["_t0"] = now
+        return sp
+
+    def close(self, sp: dict, error: str | None = None):
+        now = time.perf_counter()
+        sp["wall_s"] = round(now - sp.pop("_t0", now), 6)
+        if error is not None:
+            sp["error"] = error
+        st = self._stack()
+        # an exception can unwind past child spans whose cms have not run
+        # their own close yet in start/stop (non-with) usage — drop them
+        while st and st[-1] is not sp:
+            st.pop()
+        if st:
+            st.pop()
+
+    def add_sync(self, seconds: float):
+        sp = self.current()
+        if sp is not None:
+            sp["sync_s"] = round(sp.get("sync_s", 0.0) + seconds, 6)
+
+    def tree(self) -> list[dict]:
+        """The recorded roots, sanitized (no open-span bookkeeping keys)."""
+
+        def _clean(sp: dict) -> dict:
+            d = {k: v for k, v in sp.items() if k != "_t0"}
+            if "_t0" in sp and d.get("wall_s") is None:
+                d["error"] = d.get("error") or "unclosed"
+                d["wall_s"] = round(time.perf_counter() - sp["_t0"], 6)
+            d["children"] = [_clean(c) for c in sp["children"]]
+            return d
+
+        with self._lock:
+            return [_clean(r) for r in self.roots]
+
+
+_RECORDER: SpanRecorder | None = None
+
+
+def current_recorder() -> SpanRecorder | None:
+    return _RECORDER
+
+
+def install_recorder(rec: SpanRecorder | None) -> SpanRecorder | None:
+    """Swap the process-wide recorder; returns the previous one."""
+    global _RECORDER
+    prev = _RECORDER
+    _RECORDER = rec
+    return prev
+
+
+def start_recording(sync: bool = True) -> SpanRecorder:
+    rec = SpanRecorder(sync=sync)
+    install_recorder(rec)
+    return rec
+
+
+def stop_recording() -> SpanRecorder | None:
+    return install_recorder(None)
+
+
+@contextlib.contextmanager
+def span(name: str, stage: bool = False, **attrs):
+    """Record one span. Yields the span dict (or None when not recording).
+
+    ``stage=True`` marks a top-level prover stage: on close it also feeds
+    the flat stage sink and the per-stage log line (the pre-flight-recorder
+    observable surface). Exception-safe: a raising body still records the
+    span, with an ``error`` field (ISSUE 2 satellite: the old stage_timer
+    lost the timing line entirely)."""
+    rec = _RECORDER
+    trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
+    if (
+        rec is None
+        and trace_dir is None
+        and not _prof.profiling_enabled()
+        and _prof._STAGE_SINK is None
+    ):
+        yield None
+        return
+    ctx = contextlib.nullcontext()
+    if trace_dir:
+        import jax
+
+        ctx = jax.profiler.TraceAnnotation(name)
+    sp = rec.open(name, **attrs) if rec is not None else None
+    t0 = time.perf_counter()
+    err: BaseException | None = None
+    try:
+        with ctx:
+            yield sp
+    except BaseException as e:
+        err = e
+        raise
+    finally:
+        dt = time.perf_counter() - t0
+        error_s = None
+        if err is not None:
+            error_s = f"{type(err).__name__}: {err}"[:200]
+        if rec is not None:
+            rec.close(sp, error=error_s)
+        if stage:
+            sink = _prof._STAGE_SINK
+            if sink is not None:
+                sink.append((name, dt))
+            _prof.log(
+                f"{name}: {dt:.3f}s"
+                + (f" [error: {error_s}]" if error_s else "")
+            )
+
+
+def sync_point(x, label: str | None = None):
+    """Block on `x` (jax.block_until_ready) when the installed recorder
+    wants synced spans, charging the wait to the current span as `sync_s`.
+    Passes `x` through unchanged; a no-op without a recorder."""
+    rec = _RECORDER
+    if rec is None or not rec.sync or x is None:
+        return x
+    import jax
+
+    t0 = time.perf_counter()
+    try:
+        jax.block_until_ready(x)
+    except Exception:
+        return x
+    rec.add_sync(time.perf_counter() - t0)
+    if label:
+        sp = rec.current()
+        if sp is not None:
+            sp.setdefault("sync_points", []).append(label)
+    return x

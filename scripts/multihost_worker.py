@@ -82,6 +82,16 @@ def main():
     assert active, "jax.distributed did not come up multi-process"
     assert jax.process_count() == nprocs
 
+    # flight recorder, per-host: point each process at its own ProveReport
+    # artifact (JSONL appends from two processes into one file would
+    # interleave); prove() auto-records once the env var is set
+    report_base = os.environ.get("BOOJUM_TPU_REPORT")
+    if report_base:
+        report_path = f"{report_base}.host{pid}"
+        os.environ["BOOJUM_TPU_REPORT"] = report_path
+    else:
+        report_path = None
+
     from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
 
     cfg = ProofConfig(fri_lde_factor=4, num_queries=8, fri_final_degree=8)
@@ -108,6 +118,9 @@ def main():
         result["proof"] = proof.to_json()
     else:
         raise SystemExit(f"unknown mode {mode}")
+
+    if report_path is not None:
+        result["prove_report_path"] = report_path
 
     with open(out_path, "w") as f:
         json.dump(result, f)

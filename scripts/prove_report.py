@@ -1,0 +1,134 @@
+"""ProveReport CLI: render, diff and validate flight-recorder artifacts.
+
+Usage:
+  python scripts/prove_report.py <report.jsonl> [--index -1] [--top 10]
+      Render one report line: span tree with per-span wall/% and sync
+      time, top-N leaf spans, metrics counters/gauges, digest
+      checkpoints, compile-ledger summary.
+
+  python scripts/prove_report.py --diff <a.jsonl> <b.jsonl> [--index ...]
+      Regression triage between two reports: per-span wall deltas
+      (matched by tree path) and the FIRST diverging Fiat–Shamir digest
+      checkpoint — a bit-parity break names the stage where the
+      transcript forked instead of just a mismatching proof blob.
+      Exits 1 when the digest streams diverge.
+
+  python scripts/prove_report.py --check <report.jsonl>
+      Validate schema + digest-checkpoint monotonicity for EVERY line of
+      the artifact (the cheap post-bench gate). Exits 1 on any problem.
+
+Reports come from BOOJUM_TPU_REPORT=<path> (any prove), bench.py (labeled
+warm-up/rep lines) or scripts/multihost_worker.py (per-host files).
+
+The report library (boojum_tpu/utils/report.py) is loaded standalone —
+by file path, stdlib only — so this CLI never imports boojum_tpu or jax;
+it works on machines without an accelerator stack and costs milliseconds.
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+
+def _load_report_lib():
+    """Load boojum_tpu/utils/report.py WITHOUT importing the package (the
+    package __init__ pulls in jax and configures compilation caches —
+    pointless weight for reading JSON). Falls back to the package import
+    if the standalone load ever breaks."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "boojum_tpu", "utils", "report.py")
+    try:
+        spec = importlib.util.spec_from_file_location(
+            "_boojum_tpu_report_standalone", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        sys.path.insert(0, root)
+        from boojum_tpu.utils import report as mod  # type: ignore
+
+        return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="prove_report.py",
+        description="Render / diff / validate ProveReport JSONL artifacts",
+    )
+    ap.add_argument("paths", nargs="*", help="report artifact path(s)")
+    ap.add_argument(
+        "--diff", nargs=2, metavar=("A", "B"),
+        help="diff two report artifacts (span deltas + first diverging "
+             "digest checkpoint)",
+    )
+    ap.add_argument(
+        "--check", metavar="REPORT",
+        help="validate schema + checkpoint monotonicity of every line",
+    )
+    ap.add_argument(
+        "--index", type=int, default=-1,
+        help="which JSONL line to use (default: last)",
+    )
+    ap.add_argument(
+        "--top", type=int, default=10,
+        help="how many top spans / deltas to show (default 10)",
+    )
+    args = ap.parse_args(argv)
+    rl = _load_report_lib()
+
+    if args.check:
+        reports = rl.load_reports(args.check)
+        if not reports:
+            print(f"{args.check}: no report lines")
+            return 1
+        bad = 0
+        for i, rep in enumerate(reports):
+            problems = rl.validate_report(rep)
+            label = rep.get("label")
+            if problems:
+                bad += 1
+                print(f"line {i} ({label!r}): INVALID")
+                for p in problems:
+                    print(f"  - {p}")
+            else:
+                cov = rl.span_coverage(rep)
+                print(
+                    f"line {i} ({label!r}): ok — wall {rep.get('wall_s')}s, "
+                    f"{len(rep.get('checkpoints') or [])} checkpoints, "
+                    f"span coverage {cov * 100:.1f}%"
+                )
+        return 1 if bad else 0
+
+    if args.diff:
+        a = rl.load_report(args.diff[0], args.index)
+        b = rl.load_report(args.diff[1], args.index)
+        diff = rl.diff_reports(a, b, top=args.top)
+        print(rl.render_diff(diff))
+        return 1 if diff["first_checkpoint_divergence"] is not None else 0
+
+    if len(args.paths) == 2:
+        # convenience: two positional paths behave like --diff
+        a = rl.load_report(args.paths[0], args.index)
+        b = rl.load_report(args.paths[1], args.index)
+        diff = rl.diff_reports(a, b, top=args.top)
+        print(rl.render_diff(diff))
+        return 1 if diff["first_checkpoint_divergence"] is not None else 0
+
+    if len(args.paths) != 1:
+        ap.print_usage()
+        return 2
+    rep = rl.load_report(args.paths[0], args.index)
+    print(rl.render_report(rep, top=args.top))
+    problems = rl.validate_report(rep)
+    if problems:
+        print("PROBLEMS:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
