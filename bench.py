@@ -27,10 +27,14 @@ rides along on every JSON line and is written to BENCH_LEDGER_JSON, so a
 timeout is diagnosable from the JSON alone and compile-bill regressions
 are visible across rounds.
 
-Usage: python bench.py [--precompile-only]
+Usage: python bench.py [--precompile-only] [--no-precompile]
   --precompile-only runs synthesis + the parallel precompile, emits the
   ledger JSON line and exits — a cache-warming step to run before a bench
   or a multihost round.
+  --no-precompile skips the pre-prove parallel precompile sweep (the
+  sweep runs BY DEFAULT before the warm-up prove: round 4's watchdog
+  burned the whole budget on serial cold compiles, so BENCH lines never
+  measured a prove; equivalent to BENCH_PRECOMPILE=0).
 
 Environment knobs:
   BENCH_CIRCUIT = sha256 (default) | fma
@@ -47,6 +51,7 @@ Environment knobs:
       golden proof uses 100)
   BENCH_SKIP_NTT = 1 skips the NTT-throughput side metric
   BENCH_PRECOMPILE = 0 skips the pre-prove parallel precompile sweep
+      (same as --no-precompile; the sweep is ON by default)
   BENCH_PRECOMPILE_WORKERS = thread-pool width for it (default 8)
   BENCH_CACHE_MAX_BYTES = size cap for each repo-local .jax_cache_bench_*
       dir; oldest entries are evicted above it (default 8 GiB, 0 disables
@@ -508,9 +513,21 @@ def main():
 
     asm = cs.into_assembly()
     print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
+    if asm.trace_len >= (1 << 19):
+        # at the 2^20 HBM ceiling, queueing all Q coset sweeps async lets
+        # neighbors' working sets overlap and OOM (round-3 finding) — the
+        # overlapped prover no longer barriers by default, so the bench
+        # opts in for big traces (export BOOJUM_TPU_SYNC_SWEEPS=0 to
+        # experiment without it)
+        os.environ.setdefault("BOOJUM_TPU_SYNC_SWEEPS", "1")
+        _log("large trace: defaulting BOOJUM_TPU_SYNC_SWEEPS=1")
 
     precompile_only = "--precompile-only" in sys.argv
-    if precompile_only or os.environ.get("BENCH_PRECOMPILE", "").strip() != "0":
+    no_precompile = (
+        "--no-precompile" in sys.argv
+        or os.environ.get("BENCH_PRECOMPILE", "").strip() == "0"
+    )
+    if precompile_only or not no_precompile:
         # overlap the remote compile round-trips BEFORE the first dispatch
         # pays them serially; everything lands in the persistent cache
         _STATE["phase"] = "precompile"

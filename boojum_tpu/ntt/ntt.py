@@ -243,6 +243,20 @@ def lde_scale_rows(
     return _lde_scale_cached(log_n, lde_factor, int(coset) % gl.P)
 
 
+def warm_domain_caches(log_n: int, lde_factor: int) -> None:
+    """Populate the challenge-independent transform caches for one
+    (trace, rate) geometry: the size-n and full-domain twiddle contexts
+    plus the coset-scale matrix. The overlapped prover calls this at
+    round 0 (prover._prefetch_challenge_independent) so rounds 1-5 never
+    pay a table build at a transcript barrier; safe to call any time —
+    everything here is lru-cached and enqueue-only."""
+    get_ntt_context(log_n)
+    log_lde = lde_factor.bit_length() - 1
+    if log_lde:
+        get_ntt_context(log_n + log_lde)
+    lde_scale_rows(log_n, lde_factor)
+
+
 @lru_cache(maxsize=None)
 def _lde_scale_cached(log_n: int, lde_factor: int, coset: int) -> jax.Array:
     """(lde, n) scale matrix shift_j^i (rows in bit-reversed coset order)."""

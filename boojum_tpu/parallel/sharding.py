@@ -27,7 +27,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..field import gl
 from ..field import goldilocks as gf
 from ..field import extension as ext_f
-from ..utils import metrics as _metrics
 # the explicitly-XLA sponge entry points: this module's arrays carry
 # NamedShardings for GSPMD to partition, which pallas_call cannot split
 from ..hashes.poseidon2 import leaf_hash_xla as leaf_hash
@@ -266,23 +265,10 @@ def host_np(x):
     directly (jax raises), so gather it to every host first. Single-process
     (and plain numpy/host values) pass straight through.
 
-    This is the prover's one device->host seam, so the flight recorder's
-    d2h transfer counter lives here (no-op without a metrics registry)."""
-    was_device = isinstance(x, jax.Array)
-    try:
-        if (
-            was_device
-            and jax.process_count() > 1
-            and not x.is_fully_addressable
-        ):
-            from jax.experimental import multihost_utils
+    Delegates to utils.transfer.to_host — the pipeline's single blocking
+    d2h seam, where the flight recorder's d2h byte counter and the
+    `host.blocking_syncs` tick live (no-ops without a metrics registry).
+    Batched/prefetched pulls go through transfer.start_fetch instead."""
+    from ..utils.transfer import to_host
 
-            out = np.asarray(multihost_utils.process_allgather(x, tiled=True))
-            _metrics.count_bytes_d2h(out.nbytes)
-            return out
-    except Exception:
-        pass
-    out = np.asarray(x)
-    if was_device:
-        _metrics.count_bytes_d2h(out.nbytes)
-    return out
+    return to_host(x)

@@ -286,10 +286,11 @@ def fri_prove(
             mono1 = distribute_powers(
                 ifft_bitreversed_to_natural(cur[1]), shift_inv
             )
-    from ..parallel.sharding import host_np
+    # one batched pull for both coordinate arrays (sequenced: two
+    # blocking host_np syncs; overlapped: one, started async)
+    from ..utils.transfer import fetch_np
 
-    m0 = host_np(mono0)
-    m1 = host_np(mono1)
+    m0, m1 = fetch_np(mono0, mono1, label="fri_final_monomials")
     deg_bound = base_degree >> num_folds
     assert (m0[deg_bound:] == 0).all() and (m1[deg_bound:] == 0).all(), (
         "final FRI polynomial exceeds degree bound"

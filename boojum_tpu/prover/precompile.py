@@ -87,8 +87,15 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
         chunk_columns,
         num_gate_sweep_terms,
     )
-    from .streaming import COL_BLOCK, _absorb_lde_block, use_streamed_lde
+    from .streaming import (
+        COL_BLOCK,
+        _absorb_cols,
+        _absorb_lde_block,
+        _lde_block_cols,
+        use_streamed_lde,
+    )
     from . import prover as P
+    from ..utils import transfer as _transfer
 
     n = assembly.trace_len
     log_n = n.bit_length() - 1
@@ -157,12 +164,33 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     # _quotient_interp rather than monomial_from_values — no imono kernel
     commit_specs("q", B_q, False, mono=False)
     commit_specs("setup", B_setup, stream_setup)
+    # streamed-commit kernels follow the dispatch mode this process will
+    # actually use: the double-buffered split pair with BOOJUM_TPU_OVERLAP
+    # on (the default), the fused block graph with it off — compiling the
+    # other mode's variant would be minutes of pure waste on the tunnel
+    # compiler
+    overlap = _transfer.overlap_enabled()
     for b in sorted(absorb_blocks):
-        add(
-            f"absorb_lde_block_b{b}",
-            _absorb_lde_block, _sds(N, 12), _sds(b, n), L,
-        )
+        if overlap:
+            add(f"lde_block_cols_b{b}", _lde_block_cols, _sds(b, n), L)
+            add(f"absorb_cols_b{b}", _absorb_cols, _sds(N, 12), _sds(N, b))
+        else:
+            add(
+                f"absorb_lde_block_b{b}",
+                _absorb_lde_block, _sds(N, 12), _sds(b, n), L,
+            )
     add("node_layers", node_layers_device, _sds(N, 4), cap)
+
+    if overlap:
+        # the chunked witness upload's on-device concatenate
+        wit_groups = [Cg] + ([LC] if LC else []) + ([W] if W else []) \
+            + ([1] if M else [])
+        upload_parts = _transfer.upload_chunk_shapes(wit_groups, n)
+        if len(upload_parts) > 1:
+            add(
+                "witness_upload_concat", _transfer._concat_jit(),
+                *[_sds(b, n) for b in upload_parts],
+            )
 
     # ---- round 2: chunk products, inversions, prefix product, stack ------
     sc = (_sds(), _sds())

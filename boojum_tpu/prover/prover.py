@@ -51,6 +51,7 @@ from .fri import fri_prove
 from .pow import pow_grind
 from .proof import OracleQuery, Proof, SingleRoundQueries
 from ..utils import metrics as _metrics
+from ..utils import transfer as _transfer
 from ..utils.report import checkpoint as _checkpoint
 from ..utils.spans import span as _span
 from ..utils.spans import sync_point as _sync_point
@@ -320,28 +321,17 @@ def _dev_cached(obj, name: str, build):
     import os
 
     if os.environ.get("BOOJUM_TPU_CACHE_DEVICE_INPUTS", "").strip() == "0":
-        return _count_upload(build())
+        return _metrics.count_upload(build())
     cache = getattr(obj, "_dev_cache", None)
     if cache is None:
         cache = {}
         try:
             obj._dev_cache = cache
         except Exception:
-            return _count_upload(build())
+            return _metrics.count_upload(build())
     if name not in cache:
-        cache[name] = _count_upload(build())
+        cache[name] = _metrics.count_upload(build())
     return cache[name]
-
-
-def _count_upload(x):
-    """Tally a fresh host->device upload into the metrics registry (no-op
-    without one); cache hits in _dev_cached never reach this."""
-    if _metrics.current_registry() is not None:
-        try:
-            _metrics.count_bytes_h2d(int(x.size) * x.dtype.itemsize)
-        except Exception:
-            pass
-    return x
 
 
 def _commit_pipeline(values, L: int, cap: int, stream: bool):
@@ -717,6 +707,136 @@ def _stream_gather_fused(mono, idx_dev, L: int):
     return MonomialSource(mono, L).gather_rows(idx_dev)
 
 
+def _prefetch_challenge_independent(
+    assembly, setup, config, *, log_n, L, Q, n, lookups, lk_mode
+):
+    """Round-0 prefetch (BOOJUM_TPU_OVERLAP): every device input and
+    cached domain/twiddle table that rounds 2-5 consume and that depends
+    on NO transcript challenge is enqueued here, while the setup-cap
+    absorb and the witness commit keep the host busy. Pure enqueue +
+    cache population — nothing blocks, nothing is absorbed, so the
+    transcript (and therefore proof bytes) are untouched; the later
+    rounds simply hit the _dev_cached / lru caches instead of paying
+    their builds at a transcript barrier."""
+    import os
+
+    from ..ntt.ntt import warm_domain_caches
+    from .fri import fold_challenge_tables, fold_schedule
+
+    # twiddle/scale tables: commit rate L, quotient sweep rate Q, and the
+    # full-domain brev constants rounds 3/5 read
+    warm_domain_caches(log_n, L)
+    warm_domain_caches(log_n, Q)
+    _domain_xs_brev(log_n, L)
+    _domain_xs_brev(log_n, Q)
+    _l0_brev(log_n, Q)
+    _vanishing_inv_brev(log_n, Q)
+    if lookups:
+        _inv_xs_brev(log_n, L)
+    # FRI per-round 1/x tables (round 5)
+    log_full = log_n + (L.bit_length() - 1)
+    num_folds = sum(
+        fold_schedule(
+            n, config.fri_final_degree,
+            getattr(config, "fri_folding_schedule", None),
+        )
+    )
+    fold_challenge_tables(log_full, num_folds)
+    if os.environ.get("BOOJUM_TPU_CACHE_DEVICE_INPUTS", "").strip() == "0":
+        return  # uncached uploads here would be built twice — skip
+    # round-2 device inputs: sigma columns, grand-product x powers and
+    # non-residues, lookup tables — witness- and challenge-independent
+    ctx_n = get_ntt_context(log_n)
+    _dev_cached(setup, "sigma", lambda: jnp.asarray(setup.sigma_cols))
+    _dev_cached(setup, "xs_h", lambda: powers_device(ctx_n.omega, n))
+    _dev_cached(
+        setup,
+        "ks",
+        lambda: jnp.asarray(
+            np.array([int(k) for k in setup.non_residues], dtype=np.uint64)
+        ),
+    )
+    if lookups:
+        lp = assembly.lookup_params
+        _dev_cached(
+            assembly,
+            "table_stack",
+            lambda: jnp.asarray(assembly.stacked_table_columns(lp.width)),
+        )
+        _dev_cached(
+            assembly, "mult", lambda: jnp.asarray(assembly.multiplicities)
+        )
+        if lk_mode == "specialized":
+            _dev_cached(
+                setup,
+                "tid_col",
+                lambda: jnp.asarray(setup.constant_cols[-1]),
+            )
+        else:
+            _dev_cached(
+                setup, "consts", lambda: jnp.asarray(setup.constant_cols)
+            )
+
+
+def _deep_round5_prep(
+    assembly, *, log_n, L, N, lookups, num_partials, R_args,
+    s2_mono, wit_mono, s2_lde_flat, wit_lde_all, xs_lde, z01, zw01, omega,
+):
+    """The DEEP-challenge-INDEPENDENT half of round 5: the 1/(x-z),
+    1/(x-z*omega) denominator inversion, the shifted/lookup single-column
+    regens, and the public-input denominators all depend only on z (drawn
+    at the end of round 3) and on committed data — so with overlap on the
+    prover dispatches them DURING the round-4 evaluation pull's flight
+    window instead of serially after the DEEP challenge. Returns the prep
+    dict the round-5 body consumes; issuing it earlier or later changes
+    nothing that crosses the transcript."""
+    num_lk = (R_args + 1) if lookups else 0
+    num_pi = len(assembly.public_inputs)
+    d0, d1 = _deep_denoms_fused(xs_lde, z01, zw01)
+    dinv = ext_f.batch_inverse((d0, d1))
+    ab_off = 2 + 2 * num_partials
+    s2_idxs = [0, 1] + [ab_off + j for j in range(2 * num_lk)]
+    if isinstance(s2_lde_flat, MonomialSource):
+        s2_cols = _cols_from_mono(s2_mono, tuple(s2_idxs), L)
+    else:
+        s2_cols = s2_lde_flat[jnp.asarray(np.array(s2_idxs))]
+    inv_x = (
+        _inv_xs_brev(log_n, L) if lookups else jnp.zeros((1,), jnp.uint64)
+    )
+    if num_pi:
+        pi_cols_idx = [c_ for (c_, _r, _v) in assembly.public_inputs]
+        if isinstance(wit_lde_all, MonomialSource):
+            cols_pi = _cols_from_mono(wit_mono, tuple(pi_cols_idx), L)
+        else:
+            cols_pi = wit_lde_all[jnp.asarray(np.array(pi_cols_idx))]
+        pi_points = np.array(
+            [gl.pow_(omega, r) for (_c, r, _v) in assembly.public_inputs],
+            dtype=np.uint64,
+        )
+        pi_denoms = gf.batch_inverse(
+            gf.sub(xs_lde[None, :], jnp.asarray(pi_points)[:, None])
+        )
+        pi_vals = jnp.asarray(
+            np.array(
+                [v for (_c, _r, v) in assembly.public_inputs],
+                dtype=np.uint64,
+            )
+        )
+    else:
+        cols_pi = jnp.zeros((0, N), jnp.uint64)
+        pi_denoms = cols_pi
+        pi_vals = jnp.zeros((0,), jnp.uint64)
+    return {
+        "inv_xz": (dinv[0][0], dinv[1][0]),
+        "inv_xzw": (dinv[0][1], dinv[1][1]),
+        "s2_cols": s2_cols,
+        "inv_x": inv_x,
+        "cols_pi": cols_pi,
+        "pi_denoms": pi_denoms,
+        "pi_vals": pi_vals,
+    }
+
+
 def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     """Prove; with `mesh` (a jax.sharding.Mesh from parallel.make_mesh) the
     polynomial work shards over the mesh ('col' axis for per-column phases,
@@ -793,15 +913,6 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     lp = assembly.lookup_params
     TW = (lp.width + 1) if lookups else 0  # table setup columns
 
-    t = make_transcript(setup.vk.transcript)
-    t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
-    _checkpoint(0, "setup_cap", setup.vk.setup_merkle_cap)
-    pi_values = [v for (_c, _r, v) in assembly.public_inputs]
-    t.witness_field_elements(pi_values)
-    _checkpoint(0, "public_inputs", pi_values)
-
-    # ---- round 1: witness commitment -------------------------------------
-    clock.start("round1_witness_commit")
     from ..parallel.sharding import active_mesh, shard_cols
 
     fused = active_mesh() is None
@@ -814,14 +925,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             host_cols.append(np.asarray(assembly.wit_cols_values))
         if M:
             host_cols.append(np.asarray(assembly.multiplicities)[None, :])
-        return jnp.asarray(np.concatenate(host_cols, axis=0))
+        # chunked async device_put with overlap on, one synchronous
+        # jnp.asarray(np.concatenate) with it off — identical bytes
+        return _transfer.chunked_upload(host_cols)
 
-    witness_cols = _dev_cached(assembly, "witness_cols", _upload_witness)
-    copy_vals = witness_cols[:Ct]
-    witness_cols = shard_cols(witness_cols)
-    # round 2 consumes copy_vals directly: shard it too or the heaviest
-    # column phase (grand product + lookup polys) stays replicated
-    copy_vals = shard_cols(copy_vals)
     # streamed commit-rate mode: above the footprint threshold the rate-L
     # storages are never materialized — commits absorb column blocks into a
     # carried sponge state, DEEP/queries regenerate blocks from monomials
@@ -834,10 +941,51 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     Q_est = setup.vk.effective_quotient_degree()
     total_cols = (Ct + W + M) + (Ct + K + TW) + S_est + 2 * Q_est
     stream = fused and use_streamed_lde(total_cols, N)
+    overlap = fused and _transfer.overlap_enabled()
+    if overlap:
+        # dispatch everything challenge-independent — witness H2D chunks,
+        # the sigma/table uploads, domain/twiddle/FRI caches — while the
+        # setup-cap absorb below runs on host. Enqueue-only: transcript
+        # order (and every byte absorbed) is exactly the sequenced order.
+        import os as _os0
+
+        with _span("overlap_prefetch"):
+            # with the device-input cache disabled a prefetch upload would
+            # be discarded and re-paid in round 1 — skip it then
+            if (
+                _os0.environ.get(
+                    "BOOJUM_TPU_CACHE_DEVICE_INPUTS", ""
+                ).strip()
+                != "0"
+            ):
+                _dev_cached(assembly, "witness_cols", _upload_witness)
+            _prefetch_challenge_independent(
+                assembly, setup, config,
+                log_n=log_n, L=L, Q=Q_est, n=n,
+                lookups=lookups, lk_mode=lk_mode,
+            )
+
+    t = make_transcript(setup.vk.transcript)
+    t.witness_merkle_tree_cap(setup.vk.setup_merkle_cap)
+    _checkpoint(0, "setup_cap", setup.vk.setup_merkle_cap)
+    pi_values = [v for (_c, _r, v) in assembly.public_inputs]
+    t.witness_field_elements(pi_values)
+    _checkpoint(0, "public_inputs", pi_values)
+
+    # ---- round 1: witness commitment -------------------------------------
+    clock.start("round1_witness_commit")
+    witness_cols = _dev_cached(assembly, "witness_cols", _upload_witness)
+    copy_vals = witness_cols[:Ct]
+    witness_cols = shard_cols(witness_cols)
+    # round 2 consumes copy_vals directly: shard it too or the heaviest
+    # column phase (grand product + lookup polys) stays replicated
+    copy_vals = shard_cols(copy_vals)
     if fused:
         wit_mono, wit_lde, layers = _commit_pipeline(
             witness_cols, L, cap, stream
         )
+        if overlap:
+            _transfer.prefetch_async(layers[-1])  # cap d2h rides the queue
         wit_tree = _tree_from_layers(layers, cap)
     else:
         wit_mono = monomial_from_values(witness_cols)
@@ -931,6 +1079,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         s2_vals = stack(z_pp[0], z_pp[1], lk_inv, mult_dev, consts_dev)
         s2_mono, s2_lde, layers = _commit_pipeline(s2_vals, L, cap, stream)
         del s2_vals
+        if overlap:
+            _transfer.prefetch_async(layers[-1])
         s2_tree = _tree_from_layers(layers, cap)
         # the chunk numerator/denominator ext stacks, the z/partials and
         # the lookup denominators total ~2 GB at 2^20 rows and are dead
@@ -1079,27 +1229,18 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         sweep = _coset_sweep_fn(
             assembly, setup.selector_paths, setup.non_residues, lk_ctx
         )
-        import os as _os
-
-        # At large traces each sweep execution's working set is a
-        # significant fraction of HBM; queueing all Q async lets neighbors'
-        # allocations overlap and OOM (observed at 2^20: individually-synced
-        # sweeps pass, back-to-back queueing exhausts). A barrier per coset
-        # costs Q x ~10 ms launch RTT — noise at this scale.
-        # BOOJUM_TPU_SYNC_SWEEPS=1 forces barriers at any size, =0 disables
-        # them even at large n.
-        _sv = _os.environ.get("BOOJUM_TPU_SYNC_SWEEPS", "").strip().lower()
-        if _sv in ("0", "false", "off", "no"):
-            _sync_sweeps = False
-        elif _sv in ("1", "true", "on", "yes"):
-            _sync_sweeps = True
-        elif _sv:
-            raise ValueError(
-                f"BOOJUM_TPU_SYNC_SWEEPS={_sv!r}: use 1/true/on/yes or "
-                f"0/false/off/no"
-            )
-        else:
-            _sync_sweeps = n >= (1 << 19)
+        # No default host barrier here (the old code block_until_ready'd
+        # every sweep at n >= 2^19): the dependent dispatches already
+        # order the work — each sweep consumes its own coset's four group
+        # evaluations and the quotient tail consumes every sweep output,
+        # so the device runs them in queue order with zero host stalls.
+        # BOOJUM_TPU_SYNC_SWEEPS=1 restores a per-coset barrier for
+        # HBM-constrained geometries where bounding the number of
+        # concurrently ENQUEUED sweep working sets matters more than
+        # keeping the host ahead of the device (the entry points that
+        # drive the 2^20 ceiling — bench.py at large traces,
+        # scripts/sha2_20_driver.py — set it themselves).
+        _sync_sweeps = _transfer.env_flag("BOOJUM_TPU_SYNC_SWEEPS", False)
         T_parts0, T_parts1 = [], []
         for c in range(Q):
             ci = jnp.int32(c)
@@ -1117,6 +1258,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 lkg01 if lkg01 is not None else zero2,
             )
             if _sync_sweeps:
+                _metrics.count("host.blocking_syncs")
                 jax.block_until_ready(t1c)
             T_parts0.append(t0c)
             T_parts1.append(t1c)
@@ -1125,6 +1267,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
         )
         del T_parts0, T_parts1
+        if overlap:
+            _transfer.prefetch_async(layers[-1])
         q_tree = _tree_from_layers(layers, cap)
     else:
         T_parts0, T_parts1 = [], []
@@ -1218,15 +1362,40 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     all_mono = jnp.concatenate([wit_mono, setup.setup_monomials, s2_mono, q_mono])
     B = all_mono.shape[0]
     zw = ext_f.mul_by_base_s(z_chal, omega)
+    deep_prep = None
     if fused:
         z01 = jnp.asarray(np.array([z_chal[0], z_chal[1]], dtype=np.uint64))
         zw01 = jnp.asarray(np.array([zw[0], zw[1]], dtype=np.uint64))
         ev0, ev1, evw0, evw1 = _evals_fused(all_mono, s2_mono, z01, zw01)
+        # ONE batched, prefetched d2h for the whole evaluation round
+        # (the sequenced path pays four-plus separate blocking pulls);
+        # the lookup sums at 0 are the constant monomial coefficients,
+        # so their gather rides the same batch
+        pulls = [ev0, ev1, evw0, evw1]
+        if lookups:
+            pulls.append(s2_mono[:, 0])
+        fetch = _transfer.start_fetch(pulls, label="round4_evals")
+        if overlap:
+            # the DEEP-challenge-independent half of round 5 (denominator
+            # inversions, single-column regens, public-input denoms)
+            # dispatches inside the pull's flight window
+            with _span("deep_prep_overlap"):
+                deep_prep = _deep_round5_prep(
+                    assembly, log_n=log_n, L=L, N=N, lookups=lookups,
+                    num_partials=num_partials, R_args=R_args,
+                    s2_mono=s2_mono, wit_mono=wit_mono,
+                    s2_lde_flat=s2_lde_flat, wit_lde_all=wit_lde_all,
+                    xs_lde=xs_lde, z01=z01, zw01=zw01, omega=omega,
+                )
+        got = fetch.wait()
+        ev0, ev1, evw0, evw1 = got[:4]
+        s2_mono_host = got[4] if lookups else None
     else:
         z_pows = ext_powers_device(z_chal, n)
         ev0, ev1 = eval_monomial_at_ext_point(all_mono, z_chal, z_pows)
         zw_pows = ext_powers_device(zw, n)
         evw0, evw1 = eval_monomial_at_ext_point(s2_mono[:2], zw, zw_pows)
+        s2_mono_host = None
     from ..parallel.sharding import host_np
 
     values_at_z = [
@@ -1239,7 +1408,8 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # constant monomial coefficients
     values_at_0 = []
     if lookups:
-        s2_mono_host = host_np(s2_mono[:, 0])
+        if s2_mono_host is None:
+            s2_mono_host = host_np(s2_mono[:, 0])
         ab_off = 2 + 2 * num_partials
         for i in range(R_args + 1):
             values_at_0.append(
@@ -1286,48 +1456,31 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     num_lk = (R_args + 1) if lookups else 0
     num_pi = len(assembly.public_inputs)
     if fused:
-        # 1/(x - z), 1/(x - z*omega): one build + ONE batched inversion
-        d0, d1 = _deep_denoms_fused(xs_lde, z01, zw01)
-        dinv = ext_f.batch_inverse((d0, d1))
-        inv_xz = (dinv[0][0], dinv[1][0])
-        inv_xzw = (dinv[0][1], dinv[1][1])
+        # the challenge-independent prep — 1/(x-z), 1/(x-z*omega) (one
+        # build + ONE batched inversion), single-column regens for the
+        # remaining terms, public-input denominators — was dispatched
+        # during the round-4 evaluation pull with overlap on; compute it
+        # here (the sequenced order) otherwise
+        if deep_prep is None:
+            deep_prep = _deep_round5_prep(
+                assembly, log_n=log_n, L=L, N=N, lookups=lookups,
+                num_partials=num_partials, R_args=R_args,
+                s2_mono=s2_mono, wit_mono=wit_mono,
+                s2_lde_flat=s2_lde_flat, wit_lde_all=wit_lde_all,
+                xs_lde=xs_lde, z01=z01, zw01=zw01, omega=omega,
+            )
+        inv_xz = deep_prep["inv_xz"]
+        inv_xzw = deep_prep["inv_xzw"]
         h = _deep_main_sum(deep_sources, y0s, y1s, c0s, c1s, inv_xz)
         # the remaining terms (z at z*omega, lookup sums at 0, public
-        # inputs): gather the needed columns, then ONE fused accumulation
-        ab_off = 2 + 2 * num_partials
-        s2_idxs = [0, 1] + [
-            ab_off + j for j in range(2 * num_lk)
-        ]
-        if isinstance(s2_lde_flat, MonomialSource):
-            s2_cols = _cols_from_mono(s2_mono, tuple(s2_idxs), L)
-        else:
-            s2_cols = s2_lde_flat[jnp.asarray(np.array(s2_idxs))]
+        # inputs): the gathered columns, then ONE fused accumulation
+        s2_cols = deep_prep["s2_cols"]
         cols_zw = s2_cols[:2]
         cols_lk = s2_cols[2:]
-        inv_x = _inv_xs_brev(log_n, L) if lookups else jnp.zeros((1,), jnp.uint64)
-        if num_pi:
-            pi_cols_idx = [c_ for (c_, _r, _v) in assembly.public_inputs]
-            if isinstance(wit_lde_all, MonomialSource):
-                cols_pi = _cols_from_mono(wit_mono, tuple(pi_cols_idx), L)
-            else:
-                cols_pi = wit_lde_all[jnp.asarray(np.array(pi_cols_idx))]
-            pi_points = np.array(
-                [gl.pow_(omega, r) for (_c, r, _v) in assembly.public_inputs],
-                dtype=np.uint64,
-            )
-            pi_denoms = gf.batch_inverse(
-                gf.sub(xs_lde[None, :], jnp.asarray(pi_points)[:, None])
-            )
-            pi_vals = jnp.asarray(
-                np.array(
-                    [v for (_c, _r, v) in assembly.public_inputs],
-                    dtype=np.uint64,
-                )
-            )
-        else:
-            cols_pi = jnp.zeros((0, N), jnp.uint64)
-            pi_denoms = cols_pi
-            pi_vals = jnp.zeros((0,), jnp.uint64)
+        inv_x = deep_prep["inv_x"]
+        cols_pi = deep_prep["cols_pi"]
+        pi_denoms = deep_prep["pi_denoms"]
+        pi_vals = deep_prep["pi_vals"]
         ch0e, ch1e = deep_pows.take(2 + num_lk + num_pi)
         y_zw = (
             jnp.asarray(np.array([v[0] for v in values_at_z_omega], dtype=np.uint64)),

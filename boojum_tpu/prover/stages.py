@@ -192,7 +192,11 @@ def compute_copy_permutation_stage2(
     b = ext_scalar(beta)
     g = ext_scalar(gamma)
     chunks = chunk_columns(C, max_degree)
-    ks = jnp.asarray(np.array([int(k) for k in non_residues], dtype=np.uint64))
+    # a real h2d upload seam (the fused path's equivalent rides
+    # prover._dev_cached): keep the transfer ledger complete
+    ks = _metrics.count_upload(
+        jnp.asarray(np.array([int(k) for k in non_residues], dtype=np.uint64))
+    )
 
     _metrics.count("stage2.chunk_scans")
     with _span("stage2_grand_product"):

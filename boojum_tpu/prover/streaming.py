@@ -125,25 +125,80 @@ def streamed_leaf_digests(mono, L: int):
 def streamed_leaf_digests_blocks(mono, L: int):
     """Block-DISPATCHED form of streamed_leaf_digests: bit-identical
     digests, but each COL_BLOCK column block is its own top-level jit
-    (one LDE + 4 carried-sponge absorbs) keyed only on (block, n, L) — so
-    the expensive NTT+Poseidon2 graph is compiled ONCE and reused across
-    every block of every streamed oracle, instead of re-tracing the whole
-    B-column absorb chain into each oracle's private mega-graph (the
-    round-3 `_commit_fused` compile bill, ISSUE 1). The per-block
-    dynamic_slice start rides as an array argument, so block index never
-    enters a cache key."""
+    keyed only on (block, n, L) — so the expensive NTT+Poseidon2 graph is
+    compiled ONCE and reused across every block of every streamed oracle,
+    instead of re-tracing the whole B-column absorb chain into each
+    oracle's private mega-graph (the round-3 `_commit_fused` compile
+    bill, ISSUE 1). The per-block dynamic_slice start rides as an array
+    argument, so block index never enters a cache key.
+
+    With BOOJUM_TPU_OVERLAP (default on) the commit is DOUBLE-BUFFERED:
+    the LDE transform and the carried-sponge absorb are separate
+    dispatches, and block b+1's transform is enqueued before block b's
+    absorb — the transforms carry no data dependence on the sponge chain,
+    so the device pipelines them instead of draining between blocks. The
+    absorb order (and therefore every digest) is unchanged."""
+    from ..utils.transfer import overlap_enabled
+
     assert COL_BLOCK % 8 == 0
     n = mono.shape[-1]
     B = mono.shape[0]
     state = jnp.zeros((n * L, 12), jnp.uint64)
-    for i in range(0, B, COL_BLOCK):
+    if not overlap_enabled():
+        for i in range(0, B, COL_BLOCK):
+            b = min(COL_BLOCK, B - i)
+            blk = jax.lax.dynamic_slice_in_dim(mono, i, b, axis=0)
+            state = _absorb_lde_block(state, blk, L)
+        return state[:, :4]
+    from ..utils import metrics as _metrics
+
+    starts = list(range(0, B, COL_BLOCK))
+
+    def _lde(i):
         b = min(COL_BLOCK, B - i)
         blk = jax.lax.dynamic_slice_in_dim(mono, i, b, axis=0)
-        state = _absorb_lde_block(state, blk, L)
+        return _lde_block_cols(blk, L)
+
+    nxt = _lde(starts[0])
+    for k, _i in enumerate(starts):
+        cols, nxt = nxt, (
+            _lde(starts[k + 1]) if k + 1 < len(starts) else None
+        )
+        _metrics.count("stream.double_buffered_blocks")
+        state = _absorb_cols(state, cols)
     return state[:, :4]
 
 
 from functools import partial as _partial
+
+
+@_partial(jax.jit, static_argnums=(1,))
+def _lde_block_cols(mono_blk, L: int):
+    """One column block's rate-L leaf columns (N, b): the LDE half of
+    `_absorb_lde_block`, split out so the double-buffered commit can
+    dispatch block b+1's transform while block b absorbs. Keyed (b, n, L)
+    like the fused form."""
+    b = mono_blk.shape[0]
+    lde = lde_from_monomial(mono_blk, L)
+    return lde.reshape(b, -1).T  # (N, b)
+
+
+@jax.jit
+def _absorb_cols(state, cols):
+    """Absorb an (N, b) leaf-column block into the carried sponge state —
+    the absorb half of `_absorb_lde_block`, identical math (full 8-column
+    chunks in order, trailing partial chunk zero-pads per the sponge
+    finalize rule)."""
+    b = cols.shape[1]
+    for k in range(b // 8):
+        state = _sponge_absorb8(state, cols[:, 8 * k : 8 * k + 8])
+    rem = b % 8
+    if rem:
+        pad = jnp.zeros((cols.shape[0], 8 - rem), jnp.uint64)
+        state = _sponge_absorb8(
+            state, jnp.concatenate([cols[:, b - rem :], pad], axis=1)
+        )
+    return state
 
 
 @_partial(jax.jit, static_argnums=(2,))

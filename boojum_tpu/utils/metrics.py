@@ -43,6 +43,10 @@ class MetricsRegistry:
             if v > self.gauges.get(name, float("-inf")):
                 self.gauges[name] = v
 
+    def gauge_add(self, name: str, v: float):
+        with self._lock:
+            self.gauges[name] = self.gauges.get(name, 0.0) + float(v)
+
     def boundary(self, label: str):
         """Record a stage-boundary snapshot: live-buffer census plus (when
         the backend exposes it) device memory stats; also folds the peak
@@ -117,6 +121,25 @@ def gauge_max(name: str, v: float):
     reg = _REGISTRY
     if reg is not None:
         reg.gauge_max(name, v)
+
+
+def gauge_add(name: str, v: float):
+    reg = _REGISTRY
+    if reg is not None:
+        reg.gauge_add(name, v)
+
+
+def count_upload(x):
+    """Tally a fresh host->device upload of a device array `x` (the
+    prover's explicit upload seams — prover._dev_cached, the sequenced
+    stage-2 table uploads); passes `x` through."""
+    reg = _REGISTRY
+    if reg is not None:
+        try:
+            count_bytes_h2d(int(x.size) * x.dtype.itemsize)
+        except Exception:
+            pass
+    return x
 
 
 def count_bytes_h2d(nbytes: int):

@@ -416,6 +416,13 @@ def render_report(report: dict, top: int = 10) -> str:
         extras = ""
         if sp.get("sync_s"):
             extras += f" sync={sp['sync_s']:.3f}s"
+            if w:
+                # occupancy: how much of the span the host spent BLOCKED
+                # on the device (sync_s/wall) — the overlapped pipeline's
+                # regression signal
+                extras += f" occ={100 * sp['sync_s'] / w:.0f}%"
+        if sp.get("overlap_s"):
+            extras += f" ovl={sp['overlap_s']:.3f}s"
         if sp.get("error"):
             extras += f" ERROR={sp['error']!r}"
         lines.append(
@@ -429,15 +436,16 @@ def render_report(report: dict, top: int = 10) -> str:
         _emit(sp, 0)
 
     flat = [
-        (path, sp.get("wall_s") or 0.0)
+        (path, sp.get("wall_s") or 0.0, sp.get("sync_s") or 0.0)
         for path, sp in flatten_spans(report)
         if not sp.get("children")
     ]
     flat.sort(key=lambda t: -t[1])
     if flat:
         lines.append(f"  top {min(top, len(flat))} leaf spans:")
-        for path, w in flat[:top]:
-            lines.append(f"    {w:9.3f}s  {path}")
+        for path, w, s in flat[:top]:
+            occ = f" sync={s:.3f}s occ={100 * s / w:.0f}%" if s and w else ""
+            lines.append(f"    {w:9.3f}s{occ}  {path}")
 
     counters = (report.get("metrics") or {}).get("counters") or {}
     if counters:

@@ -93,6 +93,15 @@ class SpanRecorder:
         if sp is not None:
             sp["sync_s"] = round(sp.get("sync_s", 0.0) + seconds, 6)
 
+    def add_overlap(self, seconds: float):
+        """Charge time an async transfer batch spent in flight WHILE the
+        host kept dispatching (utils/transfer.py) — the counterpart of
+        `sync_s` (blocked time): together they make the overlap win
+        visible per span in every ProveReport."""
+        sp = self.current()
+        if sp is not None:
+            sp["overlap_s"] = round(sp.get("overlap_s", 0.0) + seconds, 6)
+
     def tree(self) -> list[dict]:
         """The recorded roots, sanitized (no open-span bookkeeping keys)."""
 

@@ -1,0 +1,265 @@
+"""Async host<->device transfers — the overlapped prove pipeline's seam.
+
+The sequenced prover blocks the host on every device->host pull (four
+separate `np.asarray` waits per evaluation round) and uploads the whole
+witness in one synchronous `jnp.asarray`, so the device queue drains at
+every transcript interaction. This module gives the prover three
+overlap primitives, all bit-transparent (only WHEN bytes move changes,
+never what is absorbed into the transcript):
+
+- `HostFetch` / `start_fetch`: a BATCH of device->host pulls started with
+  `copy_to_host_async` the moment the producing dispatches are enqueued;
+  the host keeps dispatching (challenge-independent prep, transcript
+  bookkeeping) and blocks ONCE for the whole batch at `wait()`. The
+  in-flight window is charged to the current span as `overlap_s`, the
+  blocked remainder as `sync_s`.
+- `chunked_upload`: host->device upload of a column stack in bounded row
+  chunks through `jax.device_put` (each enqueues asynchronously), joined
+  by one on-device concatenate — the upload overlaps whatever host work
+  follows (the setup-cap transcript round, in the prover).
+- `to_host`: THE blocking single-array pull (multi-process global arrays
+  gather first). `parallel.sharding.host_np` delegates here, so every
+  blocking pull in the pipeline lands in the same metrics counters.
+
+Every blocking wait counts into `host.blocking_syncs` (one per `to_host`,
+one per `HostFetch` batch regardless of batch size) — the tier-1 guard
+test asserts the overlapped prove issues strictly fewer than the
+sequenced one. `BOOJUM_TPU_OVERLAP` (default on) gates all overlap
+behavior; `=0` restores the fully sequenced transfer order.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from . import metrics as _metrics
+from . import spans as _spans
+
+# bytes per host->device chunk of `chunked_upload` (a few chunks per
+# bench-scale witness: enough to overlap, not enough to fragment)
+H2D_CHUNK_BYTES = 32 << 20
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """Shared boolean env-knob parser: 1/true/on/yes, 0/false/off/no,
+    unset/empty -> `default`; anything else raises (a typo'd knob must
+    never silently pick a mode)."""
+    v = os.environ.get(name, "").strip().lower()
+    if v in ("1", "true", "on", "yes"):
+        return True
+    if v in ("0", "false", "off", "no"):
+        return False
+    if v == "":
+        return default
+    raise ValueError(
+        f"{name}={v!r}: use 1/true/on/yes or 0/false/off/no"
+    )
+
+
+def overlap_enabled() -> bool:
+    """BOOJUM_TPU_OVERLAP: default ON; 0/false/off/no disables (the fully
+    sequenced transfer order), 1/true/on/yes forces on."""
+    return env_flag("BOOJUM_TPU_OVERLAP", True)
+
+
+def _is_device_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+def _needs_allgather(x) -> bool:
+    import jax
+
+    try:
+        return (
+            jax.process_count() > 1 and not x.is_fully_addressable
+        )
+    except Exception:
+        return False
+
+
+def to_host(x):
+    """Blocking device->host pull; np.asarray that also works for
+    MULTI-PROCESS global arrays (a sharded jax.Array spanning
+    non-addressable devices cannot be fetched directly — gather it to
+    every host first). Plain numpy/host values pass straight through.
+
+    This is the pipeline's unit of host blocking: one call = one
+    `host.blocking_syncs` tick + d2h byte accounting (no-ops without a
+    metrics registry)."""
+    was_device = _is_device_array(x)
+    if was_device and _needs_allgather(x):
+        try:
+            from jax.experimental import multihost_utils
+
+            out = np.asarray(
+                multihost_utils.process_allgather(x, tiled=True)
+            )
+            _metrics.count_bytes_d2h(out.nbytes)
+            _metrics.count("host.blocking_syncs")
+            return out
+        except Exception:
+            pass
+    out = np.asarray(x)
+    if was_device:
+        _metrics.count_bytes_d2h(out.nbytes)
+        _metrics.count("host.blocking_syncs")
+    return out
+
+
+def prefetch_async(x):
+    """Start an async device->host copy of `x` (no wait, no accounting):
+    by the time a later blocking pull touches it, the bytes are already
+    in flight — or landed. Safe no-op for host values and backends
+    without async copies."""
+    try:
+        if _is_device_array(x) and not _needs_allgather(x):
+            x.copy_to_host_async()
+    except Exception:
+        pass
+
+
+class HostFetch:
+    """A batch of device->host pulls in flight.
+
+    Construction starts every transfer (`copy_to_host_async`) without
+    blocking; `wait()` resolves them all with ONE blocking sync, counts
+    the batch's d2h bytes, and charges the current span: the window the
+    batch was in flight while the host kept working is `overlap_s`, the
+    blocked tail inside wait() is `sync_s`."""
+
+    def __init__(self, arrays, label: str | None = None):
+        self.arrays = list(arrays)
+        self.label = label
+        self._out: list | None = None
+        self._t_start = time.perf_counter()
+        for a in self.arrays:
+            prefetch_async(a)
+
+    def wait(self) -> list:
+        if self._out is not None:
+            return self._out
+        t_wait = time.perf_counter()
+        out = []
+        nbytes = 0
+        any_device = False
+        for a in self.arrays:
+            if _is_device_array(a):
+                if _needs_allgather(a):
+                    out.append(to_host(a))  # counts its own sync
+                    continue
+                any_device = True
+                h = np.asarray(a)
+                nbytes += h.nbytes
+                out.append(h)
+            else:
+                out.append(np.asarray(a))
+        if any_device:
+            _metrics.count_bytes_d2h(nbytes)
+            _metrics.count("host.blocking_syncs")
+            _metrics.count("transfer.d2h_batches")
+        now = time.perf_counter()
+        overlap_s = t_wait - self._t_start
+        sync_s = now - t_wait
+        _metrics.gauge_add("transfer.overlap_s", overlap_s)
+        _metrics.gauge_add("transfer.sync_s", sync_s)
+        rec = _spans.current_recorder()
+        if rec is not None:
+            rec.add_sync(sync_s)
+            rec.add_overlap(overlap_s)
+        self._out = out
+        return out
+
+
+class _SequencedFetch:
+    """start_fetch's overlap-off twin: nothing is started early; wait()
+    performs one fully blocking `to_host` per array (the pre-overlap
+    transfer order, one `host.blocking_syncs` tick each)."""
+
+    def __init__(self, arrays, label: str | None = None):
+        self.arrays = list(arrays)
+        self.label = label
+        self._out: list | None = None
+
+    def wait(self) -> list:
+        if self._out is None:
+            self._out = [to_host(a) for a in self.arrays]
+        return self._out
+
+
+def start_fetch(arrays, label: str | None = None):
+    """Begin a device->host batch: overlapped (`HostFetch`) when
+    BOOJUM_TPU_OVERLAP is on, fully sequenced otherwise. Either way the
+    caller gets `.wait() -> list[np.ndarray]`."""
+    if overlap_enabled():
+        return HostFetch(arrays, label=label)
+    return _SequencedFetch(arrays, label=label)
+
+
+def fetch_np(*arrays, label: str | None = None) -> list:
+    """Pull several device arrays as one batch (one blocking sync with
+    overlap on; per-array syncs with it off)."""
+    return start_fetch(arrays, label=label).wait()
+
+
+def upload_chunk_shapes(row_counts, n: int) -> list[int]:
+    """The per-chunk row counts `chunked_upload` dispatches for a stack of
+    (rows_i, n) host arrays — shared with prover/precompile.py so the
+    on-device concatenate's shape key is enumerated ahead of dispatch."""
+    per = max(1, H2D_CHUNK_BYTES // max(n * 8, 1))
+    shapes = []
+    for rows in row_counts:
+        for i in range(0, int(rows), per):
+            shapes.append(min(per, int(rows) - i))
+    return shapes
+
+
+def _concat_rows(*parts):
+    import jax.numpy as jnp
+
+    return jnp.concatenate(parts, axis=0)
+
+
+_CONCAT_JIT = None
+
+
+def _concat_jit():
+    global _CONCAT_JIT
+    if _CONCAT_JIT is None:
+        import jax
+
+        _CONCAT_JIT = jax.jit(_concat_rows)
+    return _CONCAT_JIT
+
+
+def chunked_upload(host_arrays):
+    """Upload a list of (rows_i, n) host arrays as one (sum_rows, n)
+    device stack.
+
+    Overlap on: each bounded row chunk goes up through its own
+    `jax.device_put` (async enqueue — the host returns to transcript work
+    while the DMA runs) and ONE jitted on-device concatenate joins them;
+    bit-identical to uploading the host-side concatenation. Overlap off:
+    exactly the legacy single synchronous `jnp.asarray(np.concatenate)`."""
+    import jax
+    import jax.numpy as jnp
+
+    host_arrays = [np.asarray(a) for a in host_arrays]
+    if not overlap_enabled():
+        if len(host_arrays) == 1:
+            return jnp.asarray(host_arrays[0])
+        return jnp.asarray(np.concatenate(host_arrays, axis=0))
+    n = host_arrays[0].shape[-1]
+    per = max(1, H2D_CHUNK_BYTES // max(n * 8, 1))
+    parts = []
+    for arr in host_arrays:
+        for i in range(0, arr.shape[0], per):
+            parts.append(jax.device_put(arr[i : i + per]))
+    _metrics.count("transfer.h2d_chunks", len(parts))
+    if len(parts) == 1:
+        return parts[0]
+    return _concat_jit()(*parts)

@@ -2,9 +2,14 @@
 
 Usage:
   python scripts/prove_report.py <report.jsonl> [--index -1] [--top 10]
-      Render one report line: span tree with per-span wall/% and sync
-      time, top-N leaf spans, metrics counters/gauges, digest
-      checkpoints, compile-ledger summary.
+      Render one report line: span tree with per-span wall/%, sync time
+      and OCCUPANCY (occ = sync_s/wall, the fraction of the span the
+      host spent blocked on the device — the overlapped pipeline's
+      regression signal) plus ovl (async-transfer in-flight time), the
+      top-N leaf spans with the same sync/occ columns, metrics
+      counters/gauges (incl. host.blocking_syncs and the
+      transfer.overlap_s/sync_s totals), digest checkpoints and the
+      compile-ledger summary.
 
   python scripts/prove_report.py --diff <a.jsonl> <b.jsonl> [--index ...]
       Regression triage between two reports: per-span wall deltas
