@@ -71,7 +71,10 @@ Environment knobs:
 JSON line schema 2: adds "schema", promotes the per-stage split to every
 line (warm-up split until the first timed rep lands, so even a watchdog
 line carries one) and "peak_mem" (device high-water where the backend
-exposes memory_stats, live-buffer census bytes, host max RSS).
+exposes memory_stats, live-buffer census bytes, host max RSS). Non-"ok"
+lines additionally carry "span_tree": the partial flight-recorder span
+tree of the prove in flight (open spans annotated "unclosed"), so a
+watchdog timeout localizes to the exact sub-stage instead of `{}`.
 """
 
 import json
@@ -236,6 +239,26 @@ _LINE_SCHEMA = 2
 # still shows which stages finished before the stall
 _LIVE_SINK = {"sink": None}
 
+# the LIVE span recorder of the prove in flight (the PR 2 flight
+# recorder's time axis): a watchdog line fired mid-phase carries the
+# PARTIAL hierarchical span tree — open spans annotated "unclosed" with
+# their elapsed wall — instead of an empty stage split, so a timeout
+# localizes to the exact sub-stage that stalled (BENCH_r04 gave
+# `"stages": {}` and no localization at all). _prove_recorded installs a
+# recorder for EVERY prove, with or without BOOJUM_TPU_REPORT.
+_LIVE_REC = {"rec": None}
+
+
+def _partial_span_tree():
+    rec = _LIVE_REC["rec"]
+    if rec is None:
+        return None
+    try:
+        tree = rec.tree()
+        return tree or None
+    except Exception:
+        return None
+
 
 def _update_peak_mem():
     """Fold current device/host memory high-water marks into _STATE
@@ -273,17 +296,33 @@ def _update_peak_mem():
 def _prove_recorded(label, fn):
     """Run one prove; with BOOJUM_TPU_REPORT set, record it as a labeled
     ProveReport JSONL line (span tree + metrics + digest checkpoints +
-    compile-ledger summary — utils/report.py)."""
+    compile-ledger summary — utils/report.py). WITHOUT the env var a bare
+    SpanRecorder still runs so a watchdog line fired mid-prove can carry
+    the partial span tree (nothing is written anywhere in that mode)."""
     path = os.environ.get("BOOJUM_TPU_REPORT")
     if not path:
-        out = fn()
-        _update_peak_mem()
+        from boojum_tpu.utils import spans as _spans
+
+        rec = _spans.SpanRecorder(sync=False)
+        _LIVE_REC["rec"] = rec
+        prev = _spans.install_recorder(rec)
+        try:
+            out = fn()
+            # success: drop the ref so a later stall OUTSIDE a prove never
+            # reports this finished tree as "the prove in flight" (a prove
+            # that RAISED keeps it — its partial tree is the diagnosis)
+            _LIVE_REC["rec"] = None
+        finally:
+            _spans.install_recorder(prev)
+            _update_peak_mem()
         return out
     from boojum_tpu.utils import report as _report
 
     with _report.flight_recording(label=label) as rec:
+        _LIVE_REC["rec"] = rec.spans
         try:
             out = fn()
+            _LIVE_REC["rec"] = None
         finally:
             # a failed prove still leaves its (partial, error-annotated)
             # report line — that is the diagnosable-timeout posture the
@@ -347,6 +386,14 @@ def _emit(status):
             "stages": _STATE["stages"] or _live_stage_split(),
             "peak_mem": _STATE["peak_mem"],
         }
+        if status != "ok":
+            # a watchdog/failure line localizes the stall: the partial
+            # hierarchical span tree of the prove in flight (open spans
+            # carry error="unclosed" + elapsed wall), not just the flat
+            # stage split
+            tree = _partial_span_tree()
+            if tree is not None:
+                out["span_tree"] = tree
         if _STATE["ntt_eps"] is not None:
             out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
         # the compile-ledger summary rides on EVERY line (including the

@@ -102,6 +102,119 @@ def main():
         backend=backend,
     )
 
+    sweep_section(backend)
+
+
+def timed_call(fn, args, reps=3):
+    """Median-free simple timer for non-chainable kernels (outputs have a
+    different shape than inputs, so the on-device fori_loop chain of
+    timed_chain does not apply; per-call launch overhead is identical for
+    both compared paths, so the ratio stays honest)."""
+    jax.block_until_ready(fn(*args))  # compile
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def sweep_section(backend):
+    """ISSUE 4 satellite: per-kernel u64-vs-limb microbench of the quotient
+    sweep family (gate terms, cp quotient, lookup quotient, FRI fold) —
+    one JSON line per kernel carrying both paths. On non-TPU backends the
+    limb kernels run in Pallas interpret mode (tiny sizes, correctness
+    smoke more than a perf number); on TPU they are the real fused
+    kernels at bench scale."""
+    from boojum_tpu.cs.gates import FmaGate
+    from boojum_tpu.cs.types import CSGeometry
+    from boojum_tpu.prover import pallas_sweep as ps
+    from boojum_tpu.prover.fri import _fold_once_jit
+    from boojum_tpu.prover.stages import (
+        _build_gate_sweep,
+        _cp_quotient_core,
+        _lookup_quotient_core,
+        chunk_columns,
+    )
+
+    on_tpu = backend == "tpu"
+    n = 1 << (18 if on_tpu else 10)
+    reps = 4 if on_tpu else 2
+    rng = np.random.default_rng(9)
+
+    def rnd(*s):
+        return jnp.asarray(rng.integers(0, gl.P, s, dtype=np.uint64))
+
+    def compare(name, u64_fn, limb_fn, args, elems):
+        dt_u64 = timed_call(jax.jit(u64_fn), args, reps)
+        dt_limb = timed_call(jax.jit(limb_fn), args, reps)
+        emit(
+            f"sweep_{name}_limb_elems_per_s",
+            int(elems / dt_limb),
+            "elems/s",
+            u64_elems_per_s=int(elems / dt_u64),
+            limb_over_u64=round(dt_u64 / dt_limb, 3),
+            backend=backend,
+            interpret=not on_tpu,
+        )
+
+    # gate terms (FMA sweep, 2 instances/row)
+    geom = CSGeometry(8, 0, 6, 4)
+    gates, paths = (FmaGate.instance(),), ((),)
+    n_terms = FmaGate.instance().num_repetitions(geom)
+    copy, const = rnd(8, n), rnd(6, n)
+    a0, a1 = rnd(n_terms), rnd(n_terms)
+    u64_gate = _build_gate_sweep(gates, paths, geom)
+    limb_gate = ps.gate_terms_fn(gates, paths, geom)
+    compare(
+        "gate_terms",
+        lambda c, k, x, y: u64_gate(c, None, k, x, y),
+        lambda c, k, x, y: limb_gate(c, None, k, x, y),
+        (copy, const, a0, a1), 8 * n,
+    )
+
+    # copy-permutation quotient
+    C = 8
+    chunks = tuple(tuple(c) for c in chunk_columns(C, 4))
+    ks = tuple(int(x) for x in rng.integers(1, gl.P, C, dtype=np.uint64))
+    z, zs = (rnd(n), rnd(n)), (rnd(n), rnd(n))
+    partials = [(rnd(n), rnd(n)) for _ in range(len(chunks) - 1)]
+    cp_args = (
+        z, zs, partials, rnd(C, n), rnd(C, n), rnd(n), rnd(n),
+        (jnp.uint64(3), jnp.uint64(5)), (jnp.uint64(7), jnp.uint64(11)),
+        rnd(1 + len(chunks)), rnd(1 + len(chunks)),
+    )
+    compare(
+        "cp_quotient",
+        lambda *a: _cp_quotient_core(*a, chunks, ks),
+        lambda *a: ps.cp_quotient(*a, chunks, ks),
+        cp_args, C * n,
+    )
+
+    # lookup quotient (specialized, SHA-bench width)
+    R, w = 4, 4
+    lk_args = (
+        [(rnd(n), rnd(n)) for _ in range(R)], (rnd(n), rnd(n)),
+        rnd(R * w, n), rnd(n), rnd(w + 1, n), rnd(n),
+        (jnp.uint64(3), jnp.uint64(5)), (jnp.uint64(7), jnp.uint64(11)),
+        rnd(R + 1), rnd(R + 1),
+    )
+    compare(
+        "lookup_quotient",
+        lambda *a: _lookup_quotient_core(*a, R, w),
+        lambda *a: ps.lookup_quotient(*a, R, w),
+        lk_args, R * w * n,
+    )
+
+    # FRI fold
+    m = 2 * n
+    fold_args = ((rnd(m), rnd(m)), (jnp.uint64(3), jnp.uint64(5)), rnd(m // 2))
+    compare(
+        "fri_fold",
+        lambda v, ch, ix: _fold_once_jit(v, ch, ix),
+        lambda v, ch, ix: ps.fri_fold(v, ch, ix),
+        fold_args, m,
+    )
+
 
 if __name__ == "__main__":
     main()

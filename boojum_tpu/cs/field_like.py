@@ -9,6 +9,9 @@ same contract is a tiny duck-typed ops object:
 - ScalarOps    : python ints, base field        (satisfiability checker)
 - ArrayOps     : jnp uint64 arrays, base field  (prover quotient sweep — the
                  whole LDE domain at once; XLA vectorizes)
+- LimbOps      : (lo, hi) uint32 limb pairs     (the Pallas limb-domain
+                 sweep kernels, prover/pallas_sweep.py — Mosaic has no
+                 64-bit integer datapath)
 - ExtScalarOps : (int, int) tuples, GF(p^2)     (plain verifier at z)
 - circuit ops  : gadget Nums (recursive verifier, later layer)
 """
@@ -18,6 +21,7 @@ import jax.numpy as jnp
 from ..field import gl
 from ..field import extension as ext_f
 from ..field import goldilocks as gf
+from ..field import limbs as _limbs
 
 
 class ScalarOps:
@@ -63,6 +67,31 @@ class ArrayOps:
     mul = staticmethod(gf.mul)
     neg = staticmethod(gf.neg)
     double = staticmethod(gf.double)
+
+
+class LimbOps:
+    """Base-field ops over (lo, hi) uint32 limb pairs — the SAME gate
+    evaluators run inside Pallas kernels (and in interpret mode on CPU);
+    exact mod p, bit-identical to ArrayOps after limbs.join."""
+
+    @staticmethod
+    def zero():
+        return jnp.uint32(0), jnp.uint32(0)
+
+    @staticmethod
+    def one():
+        return jnp.uint32(1), jnp.uint32(0)
+
+    @staticmethod
+    def constant(v: int):
+        lo, hi = _limbs.const_pair(v)
+        return jnp.uint32(lo), jnp.uint32(hi)
+
+    add = staticmethod(_limbs.add)
+    sub = staticmethod(_limbs.sub)
+    mul = staticmethod(_limbs.mul)
+    neg = staticmethod(_limbs.neg)
+    double = staticmethod(_limbs.double)
 
 
 class ExtScalarOps:

@@ -19,3 +19,5 @@ from .goldilocks import (
 )
 from . import gl
 from . import extension as ext
+from . import limbs
+from . import limb_ops

@@ -1,0 +1,196 @@
+"""Limb-domain algebra for the quotient sweep: GF(p^2), powers, Horner.
+
+`field/limbs.py` is the core Goldilocks algebra on `(lo, hi)` uint32 pairs —
+the representation Mosaic accepts and XLA can fuse. This module is the
+limb-domain ALGEBRA SURFACE layered on top of it (ISSUE 4): extension-field
+helpers, power/horner supplies, boundary conversions, and the accumulate /
+aggregate term combinators mirroring `prover/stages.py` — all in uint32 so
+the SAME code runs inside Pallas kernels and as plain XLA. The sweep
+kernels (`prover/pallas_sweep.py`) consume the combinators and broadcast
+helpers directly; the power/horner/conversion primitives are the
+kernel-side toolkit for stages that move limb-domain later (challenge
+tables currently ride SMEM, computed outside the kernels) — every op here,
+consumed or not yet, is pinned u64<->limb bit-exact by
+tests/test_limb_sweep.py, so the surface cannot drift from goldilocks.py.
+
+Conventions: a BASE element is a `(lo, hi)` pair of same-shape uint32
+arrays; an EXT element of GF(p^2) = GF(p)[w]/(w^2 - 7) is a `(c0, c1)`
+pair of base elements. Field ops are exact mod p and keep values
+canonical, so any evaluation order produces bit-identical results to the
+u64 path — parity is pinned per-op in tests/test_limb_sweep.py.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import gl
+from . import limbs
+from .limbs import add, double, ext_add, ext_mul, ext_sub, mul, neg, sqr, sub
+
+NON_RESIDUE = 7
+
+
+# ---------------------------------------------------------------------------
+# Broadcast helpers
+# ---------------------------------------------------------------------------
+
+
+def zeros_like(a):
+    """Base-field zero with `a`'s shape (`a` a limb pair or uint32 array)."""
+    ref = a[0] if isinstance(a, tuple) else a
+    z = jnp.zeros_like(ref)
+    return z, z
+
+
+def ones_like(a):
+    ref = a[0] if isinstance(a, tuple) else a
+    return jnp.ones_like(ref), jnp.zeros_like(ref)
+
+
+def full_like(a, value: int):
+    """A python-int field constant broadcast to `a`'s shape."""
+    ref = a[0] if isinstance(a, tuple) else a
+    clo, chi = limbs.const_pair(value)
+    return jnp.full_like(ref, clo), jnp.full_like(ref, chi)
+
+
+# ---------------------------------------------------------------------------
+# Base-field extras
+# ---------------------------------------------------------------------------
+
+
+def mul_small(a, k: int):
+    """Multiply by a small constant via modular double-and-add (mirrors
+    goldilocks.mul_small; cheap on the VPU — no 16-bit product split)."""
+    assert 0 <= k
+    if k == 0:
+        return zeros_like(a)
+    acc = None
+    addend = a
+    while k:
+        if k & 1:
+            acc = addend if acc is None else add(acc, addend)
+        k >>= 1
+        if k:
+            addend = double(addend)
+    return acc
+
+
+def powers(base, count: int):
+    """[1, b, ..., b^(count-1)] as a python list of limb pairs (traced
+    scalar chain — the limb counterpart of stages._ext_powers_traced's
+    base-field half)."""
+    assert count >= 1
+    out = [ones_like(base)]
+    for _ in range(count - 1):
+        out.append(mul(out[-1], base))
+    return out
+
+
+def horner(coeffs, x):
+    """Σ_j coeffs[j]·x^j by Horner's rule over limb pairs (coeffs[0] is the
+    constant term). Exact mod p, so it matches the powers-table form
+    bit-for-bit."""
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = add(mul(acc, x), c)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# GF(p^2) extras (ext_add / ext_sub / ext_mul live in limbs.py)
+# ---------------------------------------------------------------------------
+
+
+def ext_neg(a):
+    return neg(a[0]), neg(a[1])
+
+
+def ext_sqr(a):
+    return ext_mul(a, a)
+
+
+def ext_mul_by_base(a, b):
+    """Ext element `a` times base element `b`."""
+    return mul(a[0], b), mul(a[1], b)
+
+
+def ext_powers(base, count: int):
+    """[1, g, ..., g^(count-1)] as a python list of ext limb elements."""
+    assert count >= 1
+    out = [(ones_like(base[0]), zeros_like(base[0]))]
+    for _ in range(count - 1):
+        out.append(ext_mul(out[-1], base))
+    return out
+
+
+def ext_horner(coeffs, x):
+    """Σ_j coeffs[j]·x^j over ext limb elements."""
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = ext_add(ext_mul(acc, x), c)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Quotient-sweep combinators (stages.py counterparts, limb domain)
+# ---------------------------------------------------------------------------
+
+
+def accumulate(acc, term_base, ch):
+    """acc += ch * term for a BASE-field term and ext challenge ch
+    (stages.accumulate_ext)."""
+    t0 = mul(term_base, ch[0])
+    t1 = mul(term_base, ch[1])
+    if acc is None:
+        return (t0, t1)
+    return add(acc[0], t0), add(acc[1], t1)
+
+
+def ext_accumulate(acc, term_ext, ch):
+    """acc += ch * term for an EXT term (stages.accumulate_ext_ext)."""
+    t = ext_mul(term_ext, ch)
+    if acc is None:
+        return t
+    return ext_add(acc, t)
+
+
+def aggregate_columns(cols, table_id_col, gpow, beta):
+    """Σ_j γ^j·col_j (+ γ^w·table_id) + β over base limb columns -> ext
+    (stages.aggregate_lookup_columns). `gpow` is a list of ext elements
+    [1, γ, γ², …] (broadcastable), `beta` an ext element."""
+    like = cols[0][0] if isinstance(cols[0], tuple) else cols[0]
+    acc0 = (
+        jnp.broadcast_to(beta[0][0], like.shape),
+        jnp.broadcast_to(beta[0][1], like.shape),
+    )
+    acc1 = (
+        jnp.broadcast_to(beta[1][0], like.shape),
+        jnp.broadcast_to(beta[1][1], like.shape),
+    )
+    seq = list(cols) + ([table_id_col] if table_id_col is not None else [])
+    for j, col in enumerate(seq):
+        acc0 = add(acc0, mul(col, gpow[j][0]))
+        acc1 = add(acc1, mul(col, gpow[j][1]))
+    return acc0, acc1
+
+
+# ---------------------------------------------------------------------------
+# u64-boundary conversions for ext pairs (stage seams only)
+# ---------------------------------------------------------------------------
+
+
+def ext_split(a_u64_pair):
+    """(c0, c1) uint64 arrays -> ext limb element."""
+    return limbs.split(a_u64_pair[0]), limbs.split(a_u64_pair[1])
+
+
+def ext_join(a_limb_ext):
+    """Ext limb element -> (c0, c1) uint64 arrays."""
+    return limbs.join(a_limb_ext[0]), limbs.join(a_limb_ext[1])
+
+
+def const_ext(c0: int, c1: int = 0):
+    """Host ints -> ext element of numpy uint32 scalar pairs (bakeable)."""
+    return limbs.const_pair(c0 % gl.P), limbs.const_pair(c1 % gl.P)

@@ -242,25 +242,9 @@ def _sponge_planes(vlo, vhi, num_chunks: int, tile_rows: int, interpret: bool):
     )(jnp.asarray(_RC_U32), vlo, vhi)
 
 
-def _pick_tile(R: int, budget_rows: int) -> int:
-    """A legal Mosaic tile for the row axis: divides R (grid = R // tile
-    must cover every output row — a non-divisor would silently leave
-    trailing rows unwritten) AND is a multiple of 8 or R itself (the
-    sublane block rule). Whole-R blocks are always legal."""
-    if R <= budget_rows:
-        return R
-    best = None
-    t = 8
-    while t <= min(R, budget_rows):
-        if R % t == 0:
-            best = t
-        t *= 2
-    if best is None:
-        raise ValueError(
-            f"no legal tile for R={R} (need R % 8 == 0 when R exceeds the "
-            f"VMEM row budget {budget_rows})"
-        )
-    return best
+# tile legality (divisor-of-R, multiple-of-8 sublane rule) is shared with
+# the limb-sweep kernel family
+from ..utils.pallas_util import pick_tile as _pick_tile  # noqa: E402
 
 
 _LANE = 128

@@ -71,13 +71,26 @@ def _fold_once_jit(values, ch, inv_x_pairs):
     return (gf.mul(t[0], inv2), gf.mul(t[1], inv2))
 
 
+@jax.jit
+def _fold_once_limb_jit(values, ch, inv_x_pairs):
+    """The limb-domain fold kernel (pallas_sweep.fri_fold) under its own
+    top-level jit — the unfused path's counterpart of _fold_once_jit."""
+    from .pallas_sweep import fri_fold
+
+    return fri_fold(values, ch, inv_x_pairs)
+
+
 def fold_once(values, challenge, inv_x_pairs):
     """values: ext pair over round-r domain (brev layout); returns N/2 ext.
 
     f'(x^2) = (f(x)+f(-x))/2 + ch·(f(x)-f(-x))/(2x). Jitted core with the
-    challenge as an array argument (new challenges never retrace).
-    """
-    return _fold_once_jit(values, ext_scalar(challenge), inv_x_pairs)
+    challenge as an array argument (new challenges never retrace). With the
+    limb sweep on (BOOJUM_TPU_LIMB_SWEEP, prover/pallas_sweep.py) the
+    butterfly runs on u32 limb planes — bit-identical output."""
+    from .pallas_sweep import limb_sweep_enabled
+
+    fn = _fold_once_limb_jit if limb_sweep_enabled() else _fold_once_jit
+    return fn(values, ext_scalar(challenge), inv_x_pairs)
 
 
 def commit_codeword(
@@ -144,15 +157,23 @@ def _fri_commit_fn(k: int, cap: int):
 
 
 @lru_cache(maxsize=None)
-def _fri_fold_fn(k: int):
-    """Fused k-fold for one schedule entry (sub-challenges by squaring)."""
+def _fri_fold_fn(k: int, limb: bool = False):
+    """Fused k-fold for one schedule entry (sub-challenges by squaring).
+    With `limb`, each fold runs the u32-limb Pallas kernel
+    (pallas_sweep.fri_fold) instead of the emulated-u64 butterfly —
+    bit-identical outputs, so the two variants share nothing but math."""
+
+    if limb:
+        from .pallas_sweep import fri_fold as fold
+    else:
+        fold = _fold_once_jit
 
     @jax.jit
     def fn(c0, c1, ch01, tables):
         cur = (c0, c1)
         sub = (ch01[0], ch01[1])
         for j in range(k):
-            cur = _fold_once_jit(cur, sub, tables[j])
+            cur = fold(cur, sub, tables[j])
             sub = ext_f.mul(sub, sub)
         return cur
 
@@ -178,6 +199,8 @@ def fri_kernel_specs(base_degree: int, config) -> list:
     before the first prove. Mirrors the schedule/shape walk of fri_prove;
     args are ShapeDtypeStructs (no device memory)."""
 
+    from .pallas_sweep import limb_sweep_enabled
+
     def sds(*shape):
         return jax.ShapeDtypeStruct(shape, jnp.uint64)
 
@@ -192,6 +215,11 @@ def fri_kernel_specs(base_degree: int, config) -> list:
     cur = N
     fold_round = 0
     cap = config.merkle_tree_cap_size
+    # enumerate the fold variant this process will actually dispatch (the
+    # overlap-mode idiom in prover/precompile.py) — compiling the other
+    # would be pure waste on the tunnel compiler
+    limb = limb_sweep_enabled()
+    fold_tag = "_limb" if limb else ""
     for k in schedule:
         specs.append((
             f"fri_commit_k{k}_n{cur}",
@@ -202,8 +230,8 @@ def fri_kernel_specs(base_degree: int, config) -> list:
             sds(1 << (log_full - fold_round - j - 1)) for j in range(k)
         )
         specs.append((
-            f"fri_fold_k{k}_n{cur}",
-            _fri_fold_fn(k),
+            f"fri_fold{fold_tag}_k{k}_n{cur}",
+            _fri_fold_fn(k, limb),
             (sds(cur), sds(cur), sds(2), tables),
         ))
         fold_round += k
@@ -227,6 +255,8 @@ def fri_prove(
     (commit graph, then fold graph — the challenge only exists after the
     cap is absorbed).
     """
+    from .pallas_sweep import limb_sweep_enabled
+
     out = FriOracles()
     N = int(codeword[0].shape[0])
     log_full = N.bit_length() - 1
@@ -237,11 +267,12 @@ def fri_prove(
     out.schedule = schedule
     num_folds = sum(schedule)
     tables = fold_challenge_tables(log_full, num_folds)
+    limb = limb_sweep_enabled()
 
     cur = codeword
     fold_round = 0
     for r, k in enumerate(schedule):
-        with _span(f"fri_oracle_{r}", k=k):
+        with _span(f"fri_oracle_{r}", k=k, limb=limb):
             if fused:
                 layers = _fri_commit_fn(k, config.merkle_tree_cap_size)(*cur)
                 tree = MerkleTreeWithCap.from_layers(
@@ -260,9 +291,11 @@ def fri_prove(
             _checkpoint(5, f"fri_challenge_{r}", ch)
             out.challenges.append(ch)
             _metrics.count("fri.folds", k)
+            if limb:
+                _metrics.count("fri.limb_folds", k)
             if fused:
                 ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
-                cur = _fri_fold_fn(k)(
+                cur = _fri_fold_fn(k, limb)(
                     cur[0], cur[1], ch01,
                     tuple(tables[fold_round : fold_round + k]),
                 )

@@ -1,0 +1,761 @@
+"""Limb-domain quotient sweep + FRI fold as fused Pallas TPU kernels.
+
+ISSUE 4 tentpole. The quotient-stage cores (`stages._build_gate_sweep`,
+`_cp_quotient_core`, `_lookup_quotient_core` / `_lookup_quotient_core_general`)
+and the FRI fold (`fri._fold_once_jit`) historically computed in
+`field/goldilocks.py`'s XLA-emulated uint64 — the representation Mosaic
+rejects and XLA cannot fuse across kernel boundaries. This module evaluates
+the SAME math on `(lo, hi)` uint32 limb pairs (`field/limbs.py` +
+`field/limb_ops.py`), tiled over VMEM column blocks:
+
+- `build_coset_terms(...)`: ONE fused kernel per assembly structure that
+  evaluates, per quotient-coset block, the gate-terms contribution, the
+  copy-permutation terms, the lookup terms and the 1/Z_H multiply — the
+  limb counterpart of `prover._coset_sweep_fn`'s body. Trace columns and
+  challenges are array arguments (new challenges never retrace); challenge
+  scalars and alpha/γ-power tables ride SMEM; packed gate programs replay
+  from SMEM op tables under `fori_loop` (constant graph size).
+- `fri_fold(...)`: one fold round f'(x^2) = (f(x)+f(-x))/2 + ch·(f(x)-f(-x))/(2x)
+  on deinterleaved even/odd limb planes.
+- standalone `cp_quotient` / `lookup_quotient` / `lookup_quotient_general`
+  / `gate_terms_fn` wrappers over the same in-kernel cores, for per-kernel
+  parity tests and `bench_micro.py`'s u64-vs-limb sweep section.
+
+Layout: a `(B, n)` uint64 column stack becomes two `(B, R, 128)` uint32
+planes (R = n/128); the grid walks R in sublane tiles, so every field op is
+an elementwise VPU op over `(B, T, 128)` tiles resident in VMEM. u64↔limb
+conversion happens ONLY at these call boundaries — field ops are exact
+mod p and keep values canonical, so outputs (and therefore digests,
+checkpoints and proof bytes) are bit-identical to the u64 path
+(`BOOJUM_TPU_LIMB_SWEEP=0` restores it; tests/test_limb_sweep.py pins
+parity per kernel and end-to-end).
+
+Dispatch: default ON where the kernels are native (TPU backend, no active
+prover mesh — pallas_call cannot partition under a NamedSharding); on other
+backends `BOOJUM_TPU_LIMB_SWEEP=1` opts in via interpret mode (how the CPU
+tier-1 parity tests run). Shapes whose domain is not a multiple of 128
+lanes (deep FRI fold tails) run the same limb cores as plain XLA ops.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..cs.field_like import LimbOps
+from ..cs.gates.base import RowView, TermsCollector
+from ..field import gl
+from ..field import limb_ops as lop
+from ..field import limbs
+from ..utils import metrics as _metrics
+from ..utils.pallas_util import _FORCE_XLA, imap32, pick_tile
+
+_LANE = 128
+_INV2_PAIR = limbs.const_pair((gl.P + 1) // 2)
+
+# sweep tiles carry every oracle's column block at once; the default
+# 16 MiB scoped-vmem budget is too tight for wide geometries. Tolerate
+# both pallas API generations (CompilerParams was TPUCompilerParams
+# before jax 0.5) so interpret-mode fallback imports everywhere.
+_CP_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams", None
+)
+_CP = _CP_CLS(vmem_limit_bytes=128 * 1024 * 1024) if _CP_CLS else None
+
+
+def limb_sweep_enabled() -> bool:
+    """True when the limb-domain sweep kernels should be dispatched.
+
+    Default ON where they are native: TPU backend, no active prover mesh
+    (GSPMD cannot partition a pallas_call), no BOOJUM_TPU_LIMB_SWEEP
+    opt-out / force_xla override. On non-TPU backends the kernels run in
+    interpret mode and are OPT-IN (truthy BOOJUM_TPU_LIMB_SWEEP) — the
+    u64 path stays the CPU default so tier-1 wall-clock is unchanged.
+    The knob parses through transfer.env_flag's spelling set (0/false/
+    off/no, 1/true/on/yes; junk raises — a typo must never silently pick
+    a mode)."""
+    from ..utils.transfer import env_flag
+
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return False
+    # the backend-dependent default makes the knob tri-state: unset means
+    # "native backends only"
+    explicit = (
+        None
+        if not os.environ.get("BOOJUM_TPU_LIMB_SWEEP", "").strip()
+        else env_flag("BOOJUM_TPU_LIMB_SWEEP", False)
+    )
+    if explicit is False:
+        return False
+    if _FORCE_XLA[0]:
+        return False
+    from ..parallel.sharding import active_mesh
+
+    if active_mesh() is not None:
+        return False
+    if backend == "tpu":
+        return True
+    return explicit is True
+
+
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+# ---------------------------------------------------------------------------
+# Generic tiled dispatch: u64 stacks in, u64 ext columns out
+# ---------------------------------------------------------------------------
+
+
+def _pack_table(c0s, c1s):
+    """Ext scalar columns (two (S,) uint64 arrays) -> (4, S) uint32 SMEM
+    table, rows [c0_lo, c0_hi, c1_lo, c1_hi]."""
+    l0, h0 = limbs.split(c0s)
+    l1, h1 = limbs.split(c1s)
+    return jnp.stack([l0, h0, l1, h1])
+
+
+def _row(p, j):
+    """Row j of a (B, ...) limb-plane pair as a base limb pair."""
+    return p[0][j], p[1][j]
+
+
+def _sc_ext(tb, j, like):
+    """Scalar-table column j as an ext limb element broadcast to `like`
+    (the poseidon2 _rc_row idiom: Mosaic broadcasts SMEM scalars via
+    full_like, and the same indexing works on a plain array in the
+    direct/interpret path)."""
+    return (
+        (jnp.full_like(like, tb[0, j]), jnp.full_like(like, tb[1, j])),
+        (jnp.full_like(like, tb[2, j]), jnp.full_like(like, tb[3, j])),
+    )
+
+
+def _tiled_ext_call(
+    body, ins, table, extra_tables=(), num_ext_out=1, interpret=None
+):
+    """Run `body` over limb planes of the u64 column stacks `ins`.
+
+    ins: list of (B_i, n) uint64 arrays (same n). table: (4, S) uint32
+    scalar table (SMEM). extra_tables: int32 2-D tables (SMEM; packed gate
+    programs). body(table, tables, pairs) receives pairs[i] = (lo, hi)
+    uint32 arrays of block shape (B_i, T, 128) and returns `num_ext_out`
+    ext limb elements of shape (T, 128). Returns that many (c0, c1) uint64
+    (n,) pairs.
+
+    Domains that don't tile (n % 128 != 0) run `body` directly on
+    (B_i, 1, n) planes — same code, plain XLA."""
+    n = int(ins[0].shape[-1])
+    if interpret is None:
+        interpret = _interpret()
+    extra_tables = tuple(jnp.asarray(t) for t in extra_tables)
+    if n % _LANE != 0:
+        pairs = [limbs.split(x.reshape(x.shape[0], 1, n)) for x in ins]
+        outs = body(table, extra_tables, pairs)
+        return tuple(
+            (limbs.join(c0).reshape(n), limbs.join(c1).reshape(n))
+            for (c0, c1) in outs
+        )
+    R = n // _LANE
+    total_rows = sum(int(x.shape[0]) for x in ins) + 2 * num_ext_out
+    budget_rows = max(8, (4 << 20) // max(total_rows * _LANE * 8, 1))
+    tile = pick_tile(R, budget_rows)
+    grid = (R // tile,)
+
+    def _smem_spec(t):
+        return pl.BlockSpec(
+            t.shape, imap32(lambda *_: (0,) * t.ndim), memory_space=pltpu.SMEM
+        )
+
+    in_specs = [_smem_spec(table)]
+    args = [table]
+    for t in extra_tables:
+        in_specs.append(_smem_spec(t))
+        args.append(t)
+    for x in ins:
+        B = int(x.shape[0])
+        lo, hi = limbs.split(x.reshape(B, R, _LANE))
+        spec = pl.BlockSpec(
+            (B, tile, _LANE),
+            imap32(lambda r: (0, r, 0)),
+            memory_space=pltpu.VMEM,
+        )
+        in_specs += [spec, spec]
+        args += [lo, hi]
+    out_spec = pl.BlockSpec(
+        (tile, _LANE), imap32(lambda r: (r, 0)), memory_space=pltpu.VMEM
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((R, _LANE), jnp.uint32)
+    ] * (4 * num_ext_out)
+    n_tab = 1 + len(extra_tables)
+    n_in = len(ins)
+
+    def kernel(*refs):
+        tb = refs[0]
+        tabs = refs[1:n_tab]
+        in_refs = refs[n_tab : n_tab + 2 * n_in]
+        out_refs = refs[n_tab + 2 * n_in :]
+        pairs = [
+            (in_refs[2 * i][:], in_refs[2 * i + 1][:]) for i in range(n_in)
+        ]
+        outs = body(tb, tabs, pairs)
+        for k, (c0, c1) in enumerate(outs):
+            out_refs[4 * k][:] = c0[0]
+            out_refs[4 * k + 1][:] = c0[1]
+            out_refs[4 * k + 2][:] = c1[0]
+            out_refs[4 * k + 3][:] = c1[1]
+
+    planes = pl.pallas_call(
+        kernel,
+        grid=grid,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=[out_spec] * (4 * num_ext_out),
+        interpret=interpret,
+        compiler_params=None if interpret else _CP,
+    )(*args)
+    outs = []
+    for k in range(num_ext_out):
+        c0 = limbs.join((planes[4 * k], planes[4 * k + 1])).reshape(n)
+        c1 = limbs.join((planes[4 * k + 2], planes[4 * k + 3])).reshape(n)
+        outs.append((c0, c1))
+    return tuple(outs)
+
+
+# ---------------------------------------------------------------------------
+# In-kernel cores (limb mirrors of prover/stages.py)
+# ---------------------------------------------------------------------------
+
+
+def _cp_terms(
+    tb, like, s2_p, zs_p, copy_p, sigma_p, xs, l0,
+    a_col, beta_col, gamma_col, chunks, non_residues, num_partials,
+):
+    """Copy-permutation quotient terms (stages._cp_quotient_core), alpha
+    powers at scalar-table columns a_col.."""
+    b = _sc_ext(tb, beta_col, like)
+    g = _sc_ext(tb, gamma_col, like)
+    z = (_row(s2_p, 0), _row(s2_p, 1))
+    z_shift = (_row(zs_p, 0), _row(zs_p, 1))
+    partials = [
+        (_row(s2_p, 2 + 2 * j), _row(s2_p, 3 + 2 * j))
+        for j in range(num_partials)
+    ]
+    acc = None
+    zm1 = (limbs.sub(z[0], lop.ones_like(z[0])), z[1])
+    t0 = (limbs.mul(zm1[0], l0), limbs.mul(zm1[1], l0))
+    acc = lop.ext_accumulate(acc, t0, _sc_ext(tb, a_col, like))
+    lhs_seq = partials + [z_shift]
+    rhs_seq = [z] + partials
+    for j, chunk in enumerate(chunks):
+        num_p = den_p = None
+        for col in chunk:
+            w = _row(copy_p, col)
+            kx = limbs.mul_const(xs, limbs.const_pair(non_residues[col]))
+            num = (
+                limbs.add(limbs.add(w, limbs.mul(kx, b[0])), g[0]),
+                limbs.add(limbs.mul(kx, b[1]), g[1]),
+            )
+            s = _row(sigma_p, col)
+            den = (
+                limbs.add(limbs.add(w, limbs.mul(s, b[0])), g[0]),
+                limbs.add(limbs.mul(s, b[1]), g[1]),
+            )
+            num_p = num if num_p is None else limbs.ext_mul(num_p, num)
+            den_p = den if den_p is None else limbs.ext_mul(den_p, den)
+        term = lop.ext_sub(
+            limbs.ext_mul(lhs_seq[j], den_p), limbs.ext_mul(rhs_seq[j], num_p)
+        )
+        acc = lop.ext_accumulate(acc, term, _sc_ext(tb, a_col + 1 + j, like))
+    return acc
+
+
+def _lookup_terms(
+    tb, like, s2_p, lk_cols_p, tid, table_p, mult, sel,
+    a_col, gpow_col, ab_off, num_subargs, width, general,
+):
+    """Lookup quotient terms (stages._lookup_quotient_core and its
+    general-columns twin — `sel` is the marker selector in general mode,
+    None in specialized mode where the subtrahend is the constant 1)."""
+    gpow = [_sc_ext(tb, gpow_col + j, like) for j in range(width + 1)]
+    beta = _sc_ext(tb, gpow_col + width + 1, like)
+    acc = None
+    for i in range(num_subargs):
+        a_i = (
+            _row(s2_p, ab_off + 2 * i),
+            _row(s2_p, ab_off + 2 * i + 1),
+        )
+        cols = [_row(lk_cols_p, i * width + j) for j in range(width)]
+        den = lop.aggregate_columns(cols, tid, gpow, beta)
+        term = limbs.ext_mul(a_i, den)
+        if general:
+            term = (limbs.sub(term[0], sel), term[1])
+        else:
+            term = (limbs.sub(term[0], lop.ones_like(term[0])), term[1])
+        acc = lop.ext_accumulate(acc, term, _sc_ext(tb, a_col + i, like))
+    b_poly = (
+        _row(s2_p, ab_off + 2 * num_subargs),
+        _row(s2_p, ab_off + 2 * num_subargs + 1),
+    )
+    t_den = lop.aggregate_columns(
+        [_row(table_p, j) for j in range(width)],
+        _row(table_p, width),
+        gpow,
+        beta,
+    )
+    term = limbs.ext_mul(b_poly, t_den)
+    term = (limbs.sub(term[0], mult), term[1])
+    return lop.ext_accumulate(
+        acc, term, _sc_ext(tb, a_col + num_subargs, like)
+    )
+
+
+def _selector_from_consts(const_p, path):
+    """Product over path bits of c_b or (1 - c_b) (stages.selector_poly_lde);
+    None = constant 1 (single-gate circuits / empty marker path)."""
+    sel = None
+    for b, bit in enumerate(path):
+        col = _row(const_p, b)
+        f = col if bit else limbs.sub(lop.ones_like(col), col)
+        sel = f if sel is None else limbs.mul(sel, f)
+    return sel
+
+
+def _scan_replay(packed, ops_ref, row):
+    """Replay a PackedGateProgram over limb-pair row values: the limb twin
+    of gate_capture.scan_evaluate — regs are two stacked uint32 planes and
+    the op table streams from SMEM under one fori_loop (constant graph
+    size for permutation-sized gates)."""
+    loads = []
+    sample = None
+    for idx, reg, getter in (
+        [(i, r, row.v) for i, r in zip(packed.v_idx, packed.v_regs)]
+        + [(i, r, row.w) for i, r in zip(packed.w_idx, packed.w_regs)]
+        + [(i, r, row.c) for i, r in zip(packed.c_idx, packed.c_regs)]
+    ):
+        val = getter(idx)
+        sample = val
+        loads.append((reg, val))
+    assert sample is not None, packed.gate_name
+    shape = sample[0].shape
+    regs_lo = jnp.zeros((packed.num_regs,) + shape, jnp.uint32)
+    regs_hi = jnp.zeros((packed.num_regs,) + shape, jnp.uint32)
+    for reg, (vlo, vhi) in loads:
+        regs_lo = regs_lo.at[reg].set(jnp.broadcast_to(vlo, shape))
+        regs_hi = regs_hi.at[reg].set(jnp.broadcast_to(vhi, shape))
+    for val, reg in zip(packed.const_vals, packed.const_regs):
+        clo, chi = limbs.const_pair(val)
+        regs_lo = regs_lo.at[reg].set(jnp.full(shape, clo, jnp.uint32))
+        regs_hi = regs_hi.at[reg].set(jnp.full(shape, chi, jnp.uint32))
+
+    def step(i, carry):
+        rl, rh = carry
+        a = (
+            jax.lax.dynamic_index_in_dim(rl, ops_ref[i, 2], 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(rh, ops_ref[i, 2], 0, keepdims=False),
+        )
+        b = (
+            jax.lax.dynamic_index_in_dim(rl, ops_ref[i, 3], 0, keepdims=False),
+            jax.lax.dynamic_index_in_dim(rh, ops_ref[i, 3], 0, keepdims=False),
+        )
+        res = jax.lax.switch(
+            ops_ref[i, 0],
+            (
+                lambda x, y: limbs.add(x, y),
+                lambda x, y: limbs.sub(x, y),
+                lambda x, y: limbs.mul(x, y),
+            ),
+            a,
+            b,
+        )
+        rl = jax.lax.dynamic_update_index_in_dim(rl, res[0], ops_ref[i, 1], 0)
+        rh = jax.lax.dynamic_update_index_in_dim(rh, res[1], ops_ref[i, 1], 0)
+        return rl, rh
+
+    regs_lo, regs_hi = jax.lax.fori_loop(
+        jnp.int32(0), jnp.int32(packed.num_ops), step, (regs_lo, regs_hi)
+    )
+    return [(regs_lo[r], regs_hi[r]) for r in packed.term_regs]
+
+
+def _gate_terms(tb, tabs, like, copy_p, wit_p, const_p, plan, a_col):
+    """Gate-terms contribution (stages._build_gate_sweep core): per gate,
+    selector-masked sum over instances/terms of alpha^t·term. Consumes one
+    SMEM op table from `tabs` per packed gate, in plan order. Returns
+    (acc_ext_or_None, alpha powers consumed)."""
+    t = 0
+    tab_i = 0
+    acc = None
+    for gate, path, reps, packed in plan:
+        sel = _selector_from_consts(const_p, path)
+        ops_ref = None
+        if packed is not None:
+            ops_ref = tabs[tab_i]
+            tab_i += 1
+        gate_acc = None
+        for inst in range(reps):
+            row = RowView(
+                lambda i, o=inst * gate.principal_width: _row(copy_p, o + i),
+                lambda i, o=inst * gate.witness_width: _row(wit_p, o + i),
+                lambda i, o=len(path): _row(const_p, o + i),
+            )
+            if packed is not None:
+                terms = _scan_replay(packed, ops_ref, row)
+            else:
+                dst = TermsCollector()
+                gate.evaluate(LimbOps, row, dst)
+                terms = dst.terms
+            assert len(terms) == gate.num_terms, gate.name
+            for term in terms:
+                gate_acc = lop.accumulate(
+                    gate_acc, term, _sc_ext(tb, a_col + t, like)
+                )
+                t += 1
+        if gate_acc is not None:
+            if sel is not None:
+                gate_acc = (
+                    limbs.mul(gate_acc[0], sel),
+                    limbs.mul(gate_acc[1], sel),
+                )
+            acc = gate_acc if acc is None else lop.ext_add(acc, gate_acc)
+    return acc, t
+
+
+def _packed_tables(plan):
+    """The SMEM int32 op tables of the plan's packed gates, in plan order."""
+    return tuple(
+        np.asarray(packed.ops_arr, dtype=np.int32)
+        for _gate, _path, _reps, packed in plan
+        if packed is not None
+    )
+
+
+def _ext_scalar_cols(s):
+    """Ext scalar as two (1,) uint64 arrays (table columns)."""
+    return (
+        jnp.asarray(s[0], jnp.uint64).reshape(1),
+        jnp.asarray(s[1], jnp.uint64).reshape(1),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The fused per-coset terms kernel (prover._coset_sweep_fn's limb body)
+# ---------------------------------------------------------------------------
+
+
+def build_coset_terms(gates, selector_paths, geometry, lk_ctx, non_residues):
+    """One fused sweep kernel per assembly structure: gate terms +
+    copy-permutation terms + lookup terms + 1/Z_H, per quotient-coset
+    block. Alpha-power consumption order matches the u64 body exactly
+    (gates, then cp, then lookups) — same per-TERM challenge sequence the
+    verifier replays. Returns call(wit_v, setup_v, s2_v, zs_v, xs_sl,
+    l0_sl, zhinv_sl, ap0, ap1, beta01, gamma01, lkb01, lkg01) -> (t0, t1)
+    uint64 arrays, traceable inside the outer per-coset jit."""
+    from .stages import gate_sweep_plan
+
+    (
+        lookups, lk_mode, R_args, width, num_partials, chunks,
+        total_alpha_terms, Cg, Ct, W, K, M, mk_path,
+    ) = lk_ctx
+    non_residues = tuple(int(k) for k in non_residues)
+    plan = gate_sweep_plan(gates, selector_paths, geometry)
+    total_gate_terms = sum(
+        reps * gate.num_terms for gate, _path, reps, _packed in plan
+    )
+    expected = (
+        total_gate_terms + 1 + len(chunks) + ((R_args + 1) if lookups else 0)
+    )
+    assert expected == total_alpha_terms, (expected, total_alpha_terms)
+    tabs_static = _packed_tables(plan)
+    ab_off = 2 + 2 * num_partials
+    _metrics.count("pallas_sweep.builds")
+
+    def body(tb, tabs, pairs, A):
+        wit_p, setup_p, s2_p, zs_p, xs_p, l0_p, zh_p = pairs
+        like = xs_p[0][0]
+        xs = _row(xs_p, 0)
+        l0 = _row(l0_p, 0)
+        zh = _row(zh_p, 0)
+        copy_p = (wit_p[0][:Ct], wit_p[1][:Ct])
+        gate_wit_p = (
+            (wit_p[0][Ct : Ct + W], wit_p[1][Ct : Ct + W]) if W else None
+        )
+        sigma_p = (setup_p[0][:Ct], setup_p[1][:Ct])
+        const_p = (setup_p[0][Ct : Ct + K], setup_p[1][Ct : Ct + K])
+        table_p = (setup_p[0][Ct + K :], setup_p[1][Ct + K :])
+        t = 0
+        acc = None
+        if total_gate_terms:
+            gcopy_p = (copy_p[0][:Cg], copy_p[1][:Cg])
+            acc, t = _gate_terms(
+                tb, tabs, like, gcopy_p, gate_wit_p, const_p, plan, a_col=0
+            )
+            assert t == total_gate_terms
+        cp = _cp_terms(
+            tb, like, s2_p, zs_p, copy_p, sigma_p, xs, l0,
+            a_col=t, beta_col=A, gamma_col=A + 1,
+            chunks=chunks, non_residues=non_residues,
+            num_partials=num_partials,
+        )
+        acc = cp if acc is None else lop.ext_add(acc, cp)
+        t += 1 + len(chunks)
+        if lookups:
+            mult = _row(wit_p, Ct + W)
+            if lk_mode == "specialized":
+                lk_cols_p = (copy_p[0][Cg:], copy_p[1][Cg:])
+                tid = _row(const_p, K - 1)
+                sel = None
+            else:
+                lk_cols_p = (copy_p[0][:Cg], copy_p[1][:Cg])
+                tid = _row(const_p, len(mk_path))
+                sel = _selector_from_consts(const_p, mk_path)
+                if sel is None:
+                    sel = lop.ones_like(like)
+            lk = _lookup_terms(
+                tb, like, s2_p, lk_cols_p, tid, table_p, mult, sel,
+                a_col=t, gpow_col=A + 4, ab_off=ab_off,
+                num_subargs=R_args, width=width,
+                general=(lk_mode != "specialized"),
+            )
+            acc = lop.ext_add(acc, lk)
+        return ((limbs.mul(acc[0], zh), limbs.mul(acc[1], zh)),)
+
+    def call(
+        wit_v, setup_v, s2_v, zs_v, xs_sl, l0_sl, zhinv_sl,
+        ap0, ap1, beta01, gamma01, lkb01, lkg01,
+    ):
+        A = int(ap0.shape[0])
+        cols0 = [ap0, beta01[:1], gamma01[:1], lkb01[:1], lkg01[:1]]
+        cols1 = [ap1, beta01[1:], gamma01[1:], lkb01[1:], lkg01[1:]]
+        if lookups:
+            from .stages import _ext_powers_traced
+
+            gpow = _ext_powers_traced((lkg01[0], lkg01[1]), width + 1)
+            cols0.append(jnp.stack([p[0] for p in gpow]))
+            cols1.append(jnp.stack([p[1] for p in gpow]))
+            # beta' rides right after the γ powers (see _lookup_terms)
+            cols0.append(lkb01[:1])
+            cols1.append(lkb01[1:])
+        table = _pack_table(
+            jnp.concatenate(cols0), jnp.concatenate(cols1)
+        )
+        (out,) = _tiled_ext_call(
+            partial(body, A=A),
+            [
+                wit_v, setup_v, s2_v, zs_v,
+                xs_sl[None], l0_sl[None], zhinv_sl[None],
+            ],
+            table,
+            extra_tables=tabs_static,
+        )
+        return out
+
+    return call
+
+
+# ---------------------------------------------------------------------------
+# Standalone per-family wrappers (parity tests + bench_micro sweep section)
+# ---------------------------------------------------------------------------
+
+
+def cp_quotient(
+    z_lde, z_shift_lde, partial_ldes, copy_lde, sigma_lde, xs_lde, l0_lde,
+    b, g, a0, a1, chunks, non_residues, interpret=None,
+):
+    """Limb twin of stages._cp_quotient_core (same args, uint64 in/out)."""
+    num_partials = len(partial_ldes)
+    s2_rows = [z_lde[0], z_lde[1]]
+    for p in partial_ldes:
+        s2_rows += [p[0], p[1]]
+    s2_stack = jnp.stack(s2_rows)
+    zs_stack = jnp.stack([z_shift_lde[0], z_shift_lde[1]])
+    A = int(a0.shape[0])
+    bc0, bc1 = _ext_scalar_cols(b)
+    gc0, gc1 = _ext_scalar_cols(g)
+    table = _pack_table(
+        jnp.concatenate([a0, bc0, gc0]), jnp.concatenate([a1, bc1, gc1])
+    )
+    chunks = tuple(tuple(c) for c in chunks)
+    non_residues = tuple(int(k) for k in non_residues)
+
+    def body(tb, _tabs, pairs):
+        s2_p, zs_p, copy_p, sigma_p, xs_p, l0_p = pairs
+        like = xs_p[0][0]
+        acc = _cp_terms(
+            tb, like, s2_p, zs_p, copy_p, sigma_p,
+            _row(xs_p, 0), _row(l0_p, 0),
+            a_col=0, beta_col=A, gamma_col=A + 1,
+            chunks=chunks, non_residues=non_residues,
+            num_partials=num_partials,
+        )
+        return (acc,)
+
+    (out,) = _tiled_ext_call(
+        body,
+        [s2_stack, zs_stack, copy_lde, sigma_lde, xs_lde[None], l0_lde[None]],
+        table,
+        interpret=interpret,
+    )
+    return out
+
+
+def _lookup_quotient_shared(
+    a_ldes, b_lde, cols_lde, tid_lde, table_ldes, mult_lde, sel_lde,
+    b, g, a0, a1, num_subargs, width, general, interpret,
+):
+    s2_rows = []
+    for a in a_ldes:
+        s2_rows += [a[0], a[1]]
+    s2_rows += [b_lde[0], b_lde[1]]
+    s2_stack = jnp.stack(s2_rows)
+    gpow = None
+    from .stages import _ext_powers_traced
+
+    gpow = _ext_powers_traced(g, width + 1)
+    bc0, bc1 = _ext_scalar_cols(b)
+    A = int(a0.shape[0])
+    table = _pack_table(
+        jnp.concatenate([a0] + [jnp.reshape(p[0], (1,)) for p in gpow] + [bc0]),
+        jnp.concatenate([a1] + [jnp.reshape(p[1], (1,)) for p in gpow] + [bc1]),
+    )
+    ins = [s2_stack, cols_lde, tid_lde[None], table_ldes, mult_lde[None]]
+    if general:
+        ins.append(sel_lde[None])
+
+    def body(tb, _tabs, pairs):
+        if general:
+            s2_p, cols_p, tid_p, table_p, mult_p, sel_p = pairs
+            sel = _row(sel_p, 0)
+        else:
+            s2_p, cols_p, tid_p, table_p, mult_p = pairs
+            sel = None
+        like = tid_p[0][0]
+        acc = _lookup_terms(
+            tb, like, s2_p, cols_p, _row(tid_p, 0), table_p,
+            _row(mult_p, 0), sel,
+            a_col=0, gpow_col=A, ab_off=0,
+            num_subargs=num_subargs, width=width, general=general,
+        )
+        return (acc,)
+
+    (out,) = _tiled_ext_call(body, ins, table, interpret=interpret)
+    return out
+
+
+def lookup_quotient(
+    a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
+    b, g, a0, a1, num_repetitions, width, interpret=None,
+):
+    """Limb twin of stages._lookup_quotient_core."""
+    return _lookup_quotient_shared(
+        a_ldes, b_lde, lookup_lde_cols, table_id_lde, table_ldes, mult_lde,
+        None, b, g, a0, a1, int(num_repetitions), int(width),
+        general=False, interpret=interpret,
+    )
+
+
+def lookup_quotient_general(
+    a_ldes, b_lde, gen_lde_cols, tid_lde, table_ldes, mult_lde, sel_lde,
+    b, g, a0, a1, num_subargs, width, interpret=None,
+):
+    """Limb twin of stages._lookup_quotient_core_general."""
+    return _lookup_quotient_shared(
+        a_ldes, b_lde, gen_lde_cols, tid_lde, table_ldes, mult_lde,
+        sel_lde, b, g, a0, a1, int(num_subargs), int(width),
+        general=True, interpret=interpret,
+    )
+
+
+def gate_terms_fn(gates, selector_paths, geometry, interpret=None):
+    """Limb twin of stages._build_gate_sweep: returns fn(copy_lde_flat,
+    wit_lde_flat, const_lde_flat, a0, a1) -> ext pair."""
+    from .stages import gate_sweep_plan
+
+    plan = gate_sweep_plan(
+        tuple(gates), tuple(tuple(p) for p in selector_paths), geometry
+    )
+    tabs_static = _packed_tables(plan)
+
+    def fn(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1):
+        table = _pack_table(a0, a1)
+        ins = [copy_lde_flat]
+        has_wit = wit_lde_flat is not None
+        if has_wit:
+            ins.append(wit_lde_flat)
+        ins.append(const_lde_flat)
+
+        def body(tb, tabs, pairs):
+            if has_wit:
+                copy_p, wit_p, const_p = pairs
+            else:
+                copy_p, const_p = pairs
+                wit_p = None
+            like = copy_p[0][0]
+            acc, _t = _gate_terms(
+                tb, tabs, like, copy_p, wit_p, const_p, plan, a_col=0
+            )
+            return (acc,)
+
+        (out,) = _tiled_ext_call(
+            body, ins, table, extra_tables=tabs_static, interpret=interpret
+        )
+        return out
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# FRI fold
+# ---------------------------------------------------------------------------
+
+
+def _fold_body(tb, _tabs, pairs):
+    quad, inv = pairs
+    like = quad[0][0]
+    a = (_row(quad, 0), _row(quad, 1))
+    bm = (_row(quad, 2), _row(quad, 3))
+    invx = _row(inv, 0)
+    s = lop.ext_add(a, bm)
+    d = lop.ext_sub(a, bm)
+    d_over_x = (limbs.mul(d[0], invx), limbs.mul(d[1], invx))
+    ch = _sc_ext(tb, 0, like)
+    t = lop.ext_add(s, limbs.ext_mul(d_over_x, ch))
+    return (
+        (
+            limbs.mul_const(t[0], _INV2_PAIR),
+            limbs.mul_const(t[1], _INV2_PAIR),
+        ),
+    )
+
+
+def fri_fold(values, ch, inv_x_pairs, interpret=None):
+    """Limb twin of fri._fold_once_jit: one fold round over the
+    bit-reversed codeword (pairs adjacent). `values` is an ext pair over
+    the round domain, `ch` an ext pair of uint64 scalars; returns the
+    half-size ext pair. The even/odd deinterleave happens outside the
+    kernel (one strided XLA slice) so the kernel body is fully
+    elementwise."""
+    quad = jnp.stack(
+        [
+            values[0][0::2], values[1][0::2],
+            values[0][1::2], values[1][1::2],
+        ]
+    )
+    c0, c1 = _ext_scalar_cols(ch)
+    table = _pack_table(c0, c1)
+    (out,) = _tiled_ext_call(
+        _fold_body, [quad, inv_x_pairs[None]], table, interpret=interpret
+    )
+    return out

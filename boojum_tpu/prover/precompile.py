@@ -238,9 +238,19 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
         total_alpha_terms, Cg, Ct, W, K, M,
         tuple(mk_path) if mk_path is not None else None,
     )
+    # the sweep factory dispatches the representation this process will
+    # actually use (u64 XLA body vs the fused u32-limb Pallas kernel —
+    # BOOJUM_TPU_LIMB_SWEEP); the ledger name carries the variant so a
+    # compile-bill regression is attributable to the right kernel
+    from .pallas_sweep import limb_sweep_enabled
+
     sweep = P._coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx)
+    sweep_name = (
+        "coset_sweep_terms_limb" if limb_sweep_enabled()
+        else "coset_sweep_terms"
+    )
     add(
-        "coset_sweep_terms", sweep,
+        sweep_name, sweep,
         _sds(B_wit, n), _sds(B_setup, n), _sds(S, n), _sds(2, n), _i32(),
         _sds(Q * n), _sds(Q * n), _sds(Q * n), _sds(capA), _sds(capA),
         _sds(2), _sds(2), _sds(2), _sds(2),

@@ -478,14 +478,52 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
 
     The closure captures only structural data (gate sweep fn, counts,
     paths) — never the assembly/setup objects, so re-witnessed clones can
-    inherit it without pinning the original's witness buffers."""
-    cached = getattr(assembly, "_coset_sweep_cache", None)
-    if cached is not None:
-        return cached
+    inherit it without pinning the original's witness buffers.
+
+    Two variants, cached separately per assembly (the flag can flip
+    between proves in one process — parity tests do exactly that): the
+    u64 XLA body, and the fused u32-limb Pallas kernel
+    (pallas_sweep.build_coset_terms, BOOJUM_TPU_LIMB_SWEEP) whose outputs
+    are bit-identical."""
+    from .pallas_sweep import build_coset_terms, limb_sweep_enabled
+
+    limb = limb_sweep_enabled()
+    cache = getattr(assembly, "_coset_sweep_cache", None)
+    if not isinstance(cache, dict):
+        cache = {}
+        assembly._coset_sweep_cache = cache
+    if limb in cache:
+        return cache[limb]
 
     (lookups, lk_mode, R_args, width, num_partials, chunks,
      total_alpha_terms, Cg, Ct, W, K, M, mk_path) = lk_ctx
     non_residues = tuple(int(k) for k in non_residues)
+
+    if limb:
+        kernel = build_coset_terms(
+            tuple(assembly.gates),
+            tuple(tuple(p) for p in selector_paths),
+            assembly.geometry, lk_ctx, non_residues,
+        )
+
+        def limb_body(
+            wit_v, setup_v, s2_v, zs_v, c_arr,
+            xs_q, l0_q, zhinv_q, ap0, ap1, beta01, gamma01, lkb01, lkg01,
+        ):
+            n = wit_v.shape[-1]
+            start = c_arr * n
+            xs_sl = jax.lax.dynamic_slice_in_dim(xs_q, start, n)
+            l0_sl = jax.lax.dynamic_slice_in_dim(l0_q, start, n)
+            zhinv_sl = jax.lax.dynamic_slice_in_dim(zhinv_q, start, n)
+            return kernel(
+                wit_v, setup_v, s2_v, zs_v, xs_sl, l0_sl, zhinv_sl,
+                ap0, ap1, beta01, gamma01, lkb01, lkg01,
+            )
+
+        fn = jax.jit(limb_body)
+        cache[limb] = fn
+        return fn
+
     from .stages import _build_gate_sweep
 
     total_gate_terms = num_gate_sweep_terms(assembly)
@@ -565,7 +603,7 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
         return gf.mul(acc[0], zhinv_sl), gf.mul(acc[1], zhinv_sl)
 
     fn = jax.jit(body)
-    assembly._coset_sweep_cache = fn
+    cache[limb] = fn
     return fn
 
 
@@ -1226,6 +1264,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             total_alpha_terms, Cg, Ct, W, K, M,
             tuple(mk_path) if mk_path is not None else None,
         )
+        from .pallas_sweep import limb_sweep_enabled
+
+        _limb_sweep = limb_sweep_enabled()
         sweep = _coset_sweep_fn(
             assembly, setup.selector_paths, setup.non_residues, lk_ctx
         )
@@ -1242,27 +1283,33 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # scripts/sha2_20_driver.py — set it themselves).
         _sync_sweeps = _transfer.env_flag("BOOJUM_TPU_SYNC_SWEEPS", False)
         T_parts0, T_parts1 = [], []
-        for c in range(Q):
-            ci = jnp.int32(c)
-            _metrics.count("ntt.coset_evals", 4)
-            _metrics.count("quotient.coset_sweeps")
-            wit_v = _coset_eval_q(wit_mono, scale_q, ci)
-            setup_v = _coset_eval_q(setup.setup_monomials, scale_q, ci)
-            s2_v = _coset_eval_q(s2_mono, scale_q, ci)
-            zs_v = _coset_eval_q(zs_mono, scale_q, ci)
-            t0c, t1c = sweep(
-                wit_v, setup_v, s2_v, zs_v,
-                ci, xs_q, l0_q, zh_inv_q,
-                ap.p0, ap.p1, beta01, gamma01,
-                lkb01 if lkb01 is not None else zero2,
-                lkg01 if lkg01 is not None else zero2,
-            )
-            if _sync_sweeps:
-                _metrics.count("host.blocking_syncs")
-                jax.block_until_ready(t1c)
-            T_parts0.append(t0c)
-            T_parts1.append(t1c)
-        _sync_point(T_parts1, "round3_sweeps")
+        with _span("round3_coset_sweeps", cosets=Q, limb=_limb_sweep):
+            for c in range(Q):
+                ci = jnp.int32(c)
+                _metrics.count("ntt.coset_evals", 4)
+                _metrics.count("quotient.coset_sweeps")
+                if _limb_sweep:
+                    # flight-recorder surface: the limb-kernel dispatch
+                    # count makes "which representation ran" auditable
+                    # per report
+                    _metrics.count("quotient.limb_coset_sweeps")
+                wit_v = _coset_eval_q(wit_mono, scale_q, ci)
+                setup_v = _coset_eval_q(setup.setup_monomials, scale_q, ci)
+                s2_v = _coset_eval_q(s2_mono, scale_q, ci)
+                zs_v = _coset_eval_q(zs_mono, scale_q, ci)
+                t0c, t1c = sweep(
+                    wit_v, setup_v, s2_v, zs_v,
+                    ci, xs_q, l0_q, zh_inv_q,
+                    ap.p0, ap.p1, beta01, gamma01,
+                    lkb01 if lkb01 is not None else zero2,
+                    lkg01 if lkg01 is not None else zero2,
+                )
+                if _sync_sweeps:
+                    _metrics.count("host.blocking_syncs")
+                    jax.block_until_ready(t1c)
+                T_parts0.append(t0c)
+                T_parts1.append(t1c)
+            _sync_point(T_parts1, "round3_sweeps")
         q_mono, q_lde, layers = _quotient_tail_fused(
             tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
         )

@@ -330,6 +330,29 @@ def gate_terms_contribution(
     return fn(copy_lde_flat, wit_lde_flat, const_lde_flat, a0, a1)
 
 
+def gate_sweep_plan(gates, selector_paths, geometry):
+    """Static per-gate sweep schedule shared by the u64 sweep trace and the
+    limb-domain Pallas kernel builder (prover/pallas_sweep.py): one
+    (gate, selector_path, repetitions, packed_program) tuple per gate with
+    quotient terms, in gate order — both backends MUST consume terms (and
+    therefore alpha powers) in exactly this order or challenges desync."""
+    from ..cs.gate_capture import packed_program_for
+
+    plan = []
+    for gid, gate in enumerate(gates):
+        if gate.num_terms == 0:
+            continue
+        plan.append(
+            (
+                gate,
+                tuple(selector_paths[gid]),
+                gate.num_repetitions(geometry),
+                packed_program_for(gate),
+            )
+        )
+    return plan
+
+
 def _build_gate_sweep(gates, selector_paths, geometry):
     from ..cs.gate_capture import packed_program_for, scan_evaluate
 

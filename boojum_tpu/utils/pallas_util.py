@@ -55,6 +55,28 @@ def pallas_enabled(opt_in_env: str | None = None) -> bool:
     return active_mesh() is None
 
 
+def pick_tile(R: int, budget_rows: int) -> int:
+    """A legal Mosaic tile for a row axis of R sublane rows: divides R
+    (grid = R // tile must cover every output row — a non-divisor would
+    silently leave trailing rows unwritten) AND is a multiple of 8 or R
+    itself (the sublane block rule). Whole-R blocks are always legal.
+    (Shared by the Poseidon2 and limb-sweep kernel families.)"""
+    if R <= budget_rows:
+        return R
+    best = None
+    t = 8
+    while t <= min(R, budget_rows):
+        if R % t == 0:
+            best = t
+        t *= 2
+    if best is None:
+        raise ValueError(
+            f"no legal tile for R={R} (need R % 8 == 0 when R exceeds the "
+            f"VMEM row budget {budget_rows})"
+        )
+    return best
+
+
 def _to_i32(v):
     if isinstance(v, int):
         return jnp.int32(v)

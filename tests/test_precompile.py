@@ -77,6 +77,55 @@ def test_sha_geometry_enumeration_lowers_with_ledger():
     assert summary["num_kernels"] == len(specs)
 
 
+def test_limb_sweep_kernels_enumerate_and_lower(monkeypatch):
+    """ISSUE 4 satellite: with BOOJUM_TPU_LIMB_SWEEP=1 the enumeration
+    swaps in the limb-variant sweep kernels (the fused u32-limb Pallas
+    coset sweep and the limb FRI folds), they LOWER on CPU (interpret
+    mode traces cleanly) and land in the compile ledger under their
+    limb-tagged names."""
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.prover.precompile import enumerate_kernels, precompile
+
+    monkeypatch.setenv("BOOJUM_TPU_LIMB_SWEEP", "1")
+    geom = CSGeometry(8, 0, 6, 4)
+    cs = ConstraintSystem(geom, 1 << 10)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geom)
+    for _ in range(((1 << 10) - 8) * per_row):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    asm = cs.into_assembly()
+    cfg = ProofConfig(
+        fri_lde_factor=2,
+        merkle_tree_cap_size=4,
+        num_queries=4,
+        fri_final_degree=16,
+    )
+    specs = enumerate_kernels(asm, cfg)
+    names = [s.name for s in specs]
+    assert "coset_sweep_terms_limb" in names
+    assert "coset_sweep_terms" not in names  # only the dispatched variant
+    limb_folds = [n for n in names if n.startswith("fri_fold_limb_")]
+    assert limb_folds, names
+    assert not any(
+        n.startswith("fri_fold_k") for n in names
+    ), "u64 fold variant enumerated alongside the limb one"
+
+    ledger = CompileLedger()
+    precompile(asm, cfg, ledger=ledger, lower_only=True)
+    by_name = {e["name"]: e for e in ledger.entries}
+    for name in ["coset_sweep_terms_limb"] + limb_folds:
+        assert name in by_name, name
+        assert "error" not in by_name[name], by_name[name]
+
+    # flag off: the same enumeration returns to the u64 names
+    monkeypatch.setenv("BOOJUM_TPU_LIMB_SWEEP", "0")
+    names_u64 = [s.name for s in enumerate_kernels(asm, cfg)]
+    assert "coset_sweep_terms" in names_u64
+    assert "coset_sweep_terms_limb" not in names_u64
+
+
 # ---------------------------------------------------------------------------
 # Pre-split monolithic forms, kept verbatim as parity oracles
 # ---------------------------------------------------------------------------
