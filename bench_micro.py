@@ -103,6 +103,7 @@ def main():
     )
 
     sweep_section(backend)
+    mesh_section(backend)
 
 
 def timed_call(fn, args, reps=3):
@@ -214,6 +215,144 @@ def sweep_section(backend):
         lambda v, ch, ix: ps.fri_fold(v, ch, ix),
         fold_args, m,
     )
+
+
+def mesh_section(backend):
+    """ISSUE 5 satellite: per-kernel GSPMD-vs-shard_map microbench on the
+    largest ('col','row') mesh the local devices allow — the coset
+    evaluation (scale+NTT+pivot), the leaf sponge over pivoted rows, the
+    FRI fold chain, and the bare all_to_all layout pivot. GSPMD timings
+    dispatch the MESHLESS jitted graph on column/row-sharded operands
+    (XLA inserts the collectives); shard_map timings run the explicit
+    per-chip graphs from parallel/shard_sweep.py. Skipped (no JSON lines)
+    on single-device processes."""
+    import boojum_tpu.parallel.shard_sweep as SS
+    from boojum_tpu.parallel.sharding import prover_mesh
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    D = 1 << (len(devs).bit_length() - 1)  # largest power of two
+    if D < 2:
+        return
+    ncol = 1 << ((D.bit_length() - 1) // 2)
+    mesh = Mesh(
+        np.array(devs[:D]).reshape(ncol, D // ncol),
+        axis_names=("col", "row"),
+    )
+    on_tpu = backend == "tpu"
+    log_n, L, B = (18, 8, 32) if on_tpu else (10, 2, 16)
+    n = 1 << log_n
+    N = n * L
+    rng = np.random.default_rng(11)
+
+    def rnd(*s):
+        return jnp.asarray(rng.integers(0, gl.P, s, dtype=np.uint64))
+
+    def emit_pair(name, dt_gspmd, dt_sm, elems):
+        emit(
+            f"mesh_{name}_sm_elems_per_s",
+            int(elems / dt_sm),
+            "elems/s",
+            gspmd_elems_per_s=int(elems / dt_gspmd),
+            sm_over_gspmd=round(dt_gspmd / dt_sm, 3),
+            mesh_shape=[int(mesh.shape["col"]), int(mesh.shape["row"])],
+            backend=backend,
+        )
+
+    col_sh = NamedSharding(mesh, P(("col", "row")))
+
+    # coset evaluation: per-chip scale+NTT then the explicit pivot vs the
+    # meshless graph GSPMD-partitioned from a column-sharded operand
+    from boojum_tpu.prover.prover import _coset_eval_q
+
+    mono = rnd(B, n)
+    scale_q = rnd(L, n)
+    ci = jnp.int32(0)
+    mono_g = jax.device_put(mono, col_sh)
+    # GSPMD legs trace under the ACTIVE mesh, exactly like a real gspmd
+    # prove — pallas_enabled()'s active-mesh veto then keeps the plain XLA
+    # bodies GSPMD can partition (a meshless trace on TPU would hand a
+    # pallas_call over sharded operands to the SPMD partitioner: not the
+    # graph the mesh path ever dispatches, and not partitionable)
+    with prover_mesh(mesh):
+        dt_g = timed_call(
+            lambda m_, s_, c_: _coset_eval_q(m_, s_, c_),
+            (mono_g, scale_q, ci),
+        )
+    mono_p = SS.pad_cols_sharded(mono, mesh)
+    dt_s = timed_call(
+        SS._coset_eval_fn(mesh, B), (mono_p, scale_q, ci)
+    )
+    emit_pair("coset_eval", dt_g, dt_s, B * n)
+
+    # the materialized commit tail (LDE + col->row pivot + leaf sponge),
+    # SAME work both sides: the meshless graph GSPMD-partitioned from the
+    # column-sharded monomials (XLA inserts the pivot as a resharding of
+    # the transpose) vs the fused per-chip shard_map graph
+    from boojum_tpu.hashes.poseidon2 import leaf_hash_xla
+    from boojum_tpu.ntt import lde_from_monomial
+
+    def _lde_leaf(m):
+        lde = lde_from_monomial(m, L)
+        return lde, leaf_hash_xla(lde.reshape(m.shape[0], -1).T)
+
+    with prover_mesh(mesh):
+        dt_g = timed_call(jax.jit(_lde_leaf), (mono_g,))
+    use_limb = SS.leaf_limb_ok(B, N // SS.mesh_devices(mesh))
+    lde_fn = SS._lde_pivot_leaf_fn(mesh, L, B, use_limb)
+    dt_s = timed_call(lde_fn, (mono_p,))
+    emit_pair("leaf_sponge", dt_g, dt_s, N * B)
+
+    # FRI fold chain (k=3)
+    from boojum_tpu.prover.fri import _fri_fold_fn
+
+    m = N
+    c0, c1 = rnd(m), rnd(m)
+    ch01 = rnd(2)
+    tabs = tuple(rnd(m >> (j + 1)) for j in range(3))
+    c0g = jax.device_put(c0, col_sh)
+    c1g = jax.device_put(c1, col_sh)
+    with prover_mesh(mesh):
+        dt_g = timed_call(
+            _fri_fold_fn(3, False, None), (c0g, c1g, ch01, tabs)
+        )
+    if SS.fold_shards_ok(m, 3, mesh):
+        # both sides fold the same pre-sharded c0g/c1g; only the fold
+        # tables still need their device_put (the sm chain consumes them
+        # sharded, the meshless graph above took them from host)
+        tabs_s = tuple(jax.device_put(t, col_sh) for t in tabs)
+        dt_s = timed_call(
+            _fri_fold_fn(3, False, mesh), (c0g, c1g, ch01, tabs_s)
+        )
+        emit_pair("fri_fold_k3", dt_g, dt_s, m)
+
+    # the bare col->row layout pivot: explicit all_to_all vs the implicit
+    # resharding GSPMD inserts for the same layout change
+    from jax.experimental.shard_map import shard_map
+
+    flat = rnd(B, N)
+    col2_sh = NamedSharding(mesh, P(("col", "row"), None))
+    flat_g = jax.device_put(flat, col2_sh)
+    dt_g = timed_call(
+        jax.jit(
+            lambda x: x,
+            out_shardings=NamedSharding(mesh, P(None, ("col", "row"))),
+        ),
+        (flat_g,),
+    )
+    piv = jax.jit(
+        shard_map(
+            lambda x: jax.lax.all_to_all(
+                x, ("col", "row"), split_axis=1, concat_axis=0, tiled=True
+            ),
+            mesh=mesh,
+            in_specs=(P(("col", "row"), None),),
+            out_specs=P(None, ("col", "row")),
+            check_rep=False,
+        )
+    )
+    dt_s = timed_call(piv, (flat_g,))
+    emit_pair("pivot_all_to_all", dt_g, dt_s, B * N)
 
 
 if __name__ == "__main__":

@@ -182,7 +182,9 @@ from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 from ..utils.pallas_util import imap32  # noqa: E402
 
 # wide-leaf sponge tiles exceed the default 16 MiB scoped-vmem budget
-_CP = pltpu.CompilerParams(vmem_limit_bytes=64 * 1024 * 1024)
+from ..utils.pallas_util import tpu_compiler_params  # noqa: E402
+
+_CP = tpu_compiler_params(64 * 1024 * 1024)
 
 
 def _smem_spec():

@@ -86,7 +86,9 @@ _BIAS_PAIR = (
     np.uint32(_BIAS_TOTAL >> 32),
 )
 
-_COMPILER_PARAMS = pltpu.CompilerParams(vmem_limit_bytes=100 * 1024 * 1024)
+from ..utils.pallas_util import tpu_compiler_params
+
+_COMPILER_PARAMS = tpu_compiler_params(100 * 1024 * 1024)
 
 
 def _brev(log_n: int) -> np.ndarray:

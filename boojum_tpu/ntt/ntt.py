@@ -92,11 +92,10 @@ def _mxu_ntt_ready(n: int, ctx) -> bool:
     Default-ON on TPU (the kernel moves the multiply work onto the systolic
     array and beats the staged-XLA emulated-u64 path; parity is exact);
     BOOJUM_TPU_MXU_NTT=0 opts out."""
-    import os
-
     from ..utils.pallas_util import pallas_enabled
+    from ..utils.transfer import env_flag
 
-    if os.environ.get("BOOJUM_TPU_MXU_NTT", "").strip() == "0":
+    if not env_flag("BOOJUM_TPU_MXU_NTT", True):
         return False
     if not pallas_enabled():
         return False

@@ -17,6 +17,8 @@ Merkle caps, transcript inputs and FRI final polys are tiny and replicated.
 
 from __future__ import annotations
 
+import logging
+import os
 from functools import partial
 
 import jax
@@ -40,6 +42,41 @@ _ACTIVE_MESH: list = [None]
 def active_mesh() -> Mesh | None:
     """The mesh the prover is currently sharding over (None = single chip)."""
     return _ACTIVE_MESH[0]
+
+
+def mesh_mode() -> str | None:
+    """How the active mesh executes: None (no mesh), "shard_map" (each chip
+    runs the native kernels on its local shard, collectives written
+    explicitly — parallel/shard_sweep.py), or "gspmd" (the legacy implicit
+    path: NamedSharding constraints, XLA inserts the collectives).
+
+    BOOJUM_TPU_MESH_MODE=shard_map|gspmd forces a mode. Unset defaults to
+    shard_map for single-process meshes; multi-process (DCN-spanning)
+    meshes keep gspmd — the explicit-collective path is validated over ICI
+    within one process, not across jax.distributed yet."""
+    m = active_mesh()
+    if m is None:
+        return None
+    v = os.environ.get("BOOJUM_TPU_MESH_MODE", "").strip().lower()
+    if v in ("shard_map", "sm"):
+        return "shard_map"
+    if v == "gspmd":
+        return "gspmd"
+    if v:
+        raise ValueError(
+            f"BOOJUM_TPU_MESH_MODE={v!r}: use shard_map or gspmd"
+        )
+    try:
+        multi = jax.process_count() > 1
+    except Exception:
+        multi = False
+    return "gspmd" if multi else "shard_map"
+
+
+def shard_map_mesh() -> Mesh | None:
+    """The active mesh when it executes via shard_map, else None — the
+    single dispatch predicate the prover/fri/streaming kernels key on."""
+    return active_mesh() if mesh_mode() == "shard_map" else None
 
 
 class prover_mesh:
@@ -66,12 +103,39 @@ class prover_mesh:
         return False
 
 
+_SHARD_COLS_WARNED: set = set()
+
+
+def _note_shard_axis(axis: str, shape, ncol: int):
+    """Audit trail for shard_cols' divisibility fallback: the chosen axis
+    lands on the current flight-recorder span as an attribute, and every
+    fallback away from 'col' logs ONE warning per (shape, mesh) so mesh
+    runs silently sharding the wrong axis become visible."""
+    from ..utils.spans import span_attr
+
+    span_attr("shard_cols_axis", axis)
+    if axis == "col":
+        return
+    key = (axis, tuple(shape), ncol)
+    if key in _SHARD_COLS_WARNED:
+        return
+    _SHARD_COLS_WARNED.add(key)
+    logging.getLogger("boojum_tpu").warning(
+        "shard_cols: batch axis %s does not divide the %d-way 'col' mesh "
+        "axis; sharding %s instead",
+        shape,
+        ncol,
+        "the domain axis" if axis.startswith("domain") else "nothing",
+    )
+
+
 def shard_cols(arr):
     """Column-shard a (C, ...) polynomial batch over the active mesh (no-op
     when no mesh is active). Column counts are arbitrary (e.g. 15 oracle
     columns over a 4-way axis), and NamedSharding demands divisibility, so
     when 'col' does not divide the batch axis the (power-of-two) domain axis
-    is sharded instead — the row axis always divides it."""
+    is sharded instead — the row axis always divides it. Fallbacks are
+    logged once and recorded as a span attribute (_note_shard_axis)."""
     m = active_mesh()
     if m is None:
         return arr
@@ -79,11 +143,15 @@ def shard_cols(arr):
     nd = arr.ndim
     if arr.shape[0] % ncol == 0:
         spec = P("col", *([None] * (nd - 1)))
+        _note_shard_axis("col", arr.shape, ncol)
     elif arr.shape[-1] % (ncol * nrow) == 0:
         spec = P(*([None] * (nd - 1)), ("col", "row"))
+        _note_shard_axis("domain(col,row)", arr.shape, ncol)
     elif arr.shape[-1] % nrow == 0:
         spec = P(*([None] * (nd - 1)), "row")
+        _note_shard_axis("domain(row)", arr.shape, ncol)
     else:
+        _note_shard_axis("none", arr.shape, ncol)
         return arr
     return jax.device_put(arr, NamedSharding(m, spec))
 

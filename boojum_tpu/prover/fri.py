@@ -157,16 +157,47 @@ def _fri_commit_fn(k: int, cap: int):
 
 
 @lru_cache(maxsize=None)
-def _fri_fold_fn(k: int, limb: bool = False):
+def _fri_fold_fn(k: int, limb: bool = False, mesh=None):
     """Fused k-fold for one schedule entry (sub-challenges by squaring).
     With `limb`, each fold runs the u32-limb Pallas kernel
     (pallas_sweep.fri_fold) instead of the emulated-u64 butterfly —
-    bit-identical outputs, so the two variants share nothing but math."""
+    bit-identical outputs, so the two variants share nothing but math.
+    With `mesh` (a shard_map mesh, parallel/shard_sweep.py) the whole
+    k-fold chain runs per chip on row shards of the bit-reversed codeword:
+    fold pairs are adjacent, so as long as every intermediate local size
+    stays even (fri_prove guards divisibility) no fold ever communicates
+    — the only collective in FRI is the cap gather at commit time."""
 
     if limb:
         from .pallas_sweep import fri_fold as fold
     else:
         fold = _fold_once_jit
+
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(("col", "row"))
+
+        def body(c0, c1, ch01, *tabs):
+            cur = (c0, c1)
+            sub = (ch01[0], ch01[1])
+            for j in range(k):
+                cur = fold(cur, sub, tabs[j])
+                sub = ext_f.mul(sub, sub)
+            return cur
+
+        smf = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, P(None)) + (spec,) * k,
+            out_specs=(spec, spec), check_rep=False,
+        )
+
+        @jax.jit
+        def fn(c0, c1, ch01, tables):
+            return smf(c0, c1, ch01, *tables)
+
+        return fn
 
     @jax.jit
     def fn(c0, c1, ch01, tables):
@@ -191,7 +222,7 @@ def _fri_final_fused(c0, c1, shift_inv: int):
     return m0, m1
 
 
-def fri_kernel_specs(base_degree: int, config) -> list:
+def fri_kernel_specs(base_degree: int, config, mesh=None) -> list:
     """(name, jitted_fn, args) triples for every top-level executable a
     fused `fri_prove` dispatches for this (base_degree, config) — the
     per-schedule-entry commit and fold graphs plus the final
@@ -217,21 +248,38 @@ def fri_kernel_specs(base_degree: int, config) -> list:
     cap = config.merkle_tree_cap_size
     # enumerate the fold variant this process will actually dispatch (the
     # overlap-mode idiom in prover/precompile.py) — compiling the other
-    # would be pure waste on the tunnel compiler
+    # would be pure waste on the tunnel compiler. Under a shard_map mesh
+    # that is the per-chip fold chain, ledger-tagged `_sm`.
+    from ..parallel.sharding import shard_map_mesh
+    from ..parallel.shard_sweep import fold_shards_ok
+
     limb = limb_sweep_enabled()
+    smm = mesh if mesh is not None else shard_map_mesh()
     fold_tag = "_limb" if limb else ""
     for k in schedule:
-        specs.append((
-            f"fri_commit_k{k}_n{cur}",
-            _fri_commit_fn(k, cap),
-            (sds(cur), sds(cur)),
-        ))
+        mesh_k = smm if smm is not None and fold_shards_ok(cur, k, smm) \
+            else None
+        if mesh_k is not None:
+            from ..parallel.shard_sweep import _fri_leaf_fn
+
+            specs.append((
+                f"fri_leaf_k{k}_n{cur}_sm",
+                _fri_leaf_fn(mesh_k, k),
+                (sds(cur), sds(cur)),
+            ))
+        else:
+            specs.append((
+                f"fri_commit_k{k}_n{cur}",
+                _fri_commit_fn(k, cap),
+                (sds(cur), sds(cur)),
+            ))
         tables = tuple(
             sds(1 << (log_full - fold_round - j - 1)) for j in range(k)
         )
         specs.append((
-            f"fri_fold{fold_tag}_k{k}_n{cur}",
-            _fri_fold_fn(k, limb),
+            f"fri_fold{fold_tag}_k{k}_n{cur}"
+            + ("_sm" if mesh_k is not None else ""),
+            _fri_fold_fn(k, limb, mesh_k),
             (sds(cur), sds(cur), sds(2), tables),
         ))
         fold_round += k
@@ -268,13 +316,47 @@ def fri_prove(
     num_folds = sum(schedule)
     tables = fold_challenge_tables(log_full, num_folds)
     limb = limb_sweep_enabled()
+    from ..parallel.sharding import shard_map_mesh
+    from ..parallel.shard_sweep import fold_shards_ok
+
+    smm = shard_map_mesh()
+    if smm is not None and len(codeword[0].devices()) <= 1:
+        # streamed proves de-mesh their round-5 inputs (the DEEP sources
+        # regenerate blocks inside plain jits), so the codeword arrives
+        # on ONE device — the per-chip commit/fold graphs would reject
+        # it. Run the whole FRI chain meshless; values are identical.
+        smm = None
 
     cur = codeword
     fold_round = 0
     for r, k in enumerate(schedule):
         with _span(f"fri_oracle_{r}", k=k, limb=limb):
+            # per-chip commit + fold chain while every intermediate local
+            # size stays even; deep tails are pulled onto one device and
+            # take the meshless graphs (the arrays are small there, and a
+            # plain jit over a still-sharded operand would go through the
+            # SPMD partitioner)
+            mesh_k = (
+                smm
+                if smm is not None
+                and fold_shards_ok(int(cur[0].shape[0]), k, smm)
+                else None
+            )
+            if smm is not None and mesh_k is None:
+                from ..parallel.shard_sweep import demesh
+
+                cur = demesh(cur)
             if fused:
-                layers = _fri_commit_fn(k, config.merkle_tree_cap_size)(*cur)
+                if mesh_k is not None:
+                    from ..parallel.shard_sweep import fri_commit_sm
+
+                    layers = fri_commit_sm(
+                        cur, k, config.merkle_tree_cap_size, mesh_k
+                    )
+                else:
+                    layers = _fri_commit_fn(
+                        k, config.merkle_tree_cap_size
+                    )(*cur)
                 tree = MerkleTreeWithCap.from_layers(
                     list(layers), config.merkle_tree_cap_size
                 )
@@ -295,7 +377,9 @@ def fri_prove(
                 _metrics.count("fri.limb_folds", k)
             if fused:
                 ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
-                cur = _fri_fold_fn(k, limb)(
+                if mesh_k is not None:
+                    _metrics.count("fri.sm_folds", k)
+                cur = _fri_fold_fn(k, limb, mesh_k)(
                     cur[0], cur[1], ch01,
                     tuple(tables[fold_round : fold_round + k]),
                 )
@@ -310,6 +394,10 @@ def fri_prove(
     n_fin = N >> num_folds
     shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
     with _span("fri_final_interpolation"):
+        if smm is not None:
+            from ..parallel.shard_sweep import demesh
+
+            cur = demesh(cur)
         if fused:
             mono0, mono1 = _fri_final_fused(cur[0], cur[1], shift_inv)
         else:

@@ -39,7 +39,6 @@ lanes (deep FRI fold tails) run the same limb cores as plain XLA ops.
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
@@ -54,33 +53,37 @@ from ..field import gl
 from ..field import limb_ops as lop
 from ..field import limbs
 from ..utils import metrics as _metrics
-from ..utils.pallas_util import _FORCE_XLA, imap32, pick_tile
+from ..utils.pallas_util import (
+    _FORCE_XLA,
+    imap32,
+    pick_tile,
+    tpu_compiler_params,
+)
 
 _LANE = 128
 _INV2_PAIR = limbs.const_pair((gl.P + 1) // 2)
 
 # sweep tiles carry every oracle's column block at once; the default
-# 16 MiB scoped-vmem budget is too tight for wide geometries. Tolerate
-# both pallas API generations (CompilerParams was TPUCompilerParams
-# before jax 0.5) so interpret-mode fallback imports everywhere.
-_CP_CLS = getattr(pltpu, "CompilerParams", None) or getattr(
-    pltpu, "TPUCompilerParams", None
-)
-_CP = _CP_CLS(vmem_limit_bytes=128 * 1024 * 1024) if _CP_CLS else None
+# 16 MiB scoped-vmem budget is too tight for wide geometries
+_CP = tpu_compiler_params(128 * 1024 * 1024)
 
 
 def limb_sweep_enabled() -> bool:
     """True when the limb-domain sweep kernels should be dispatched.
 
-    Default ON where they are native: TPU backend, no active prover mesh
-    (GSPMD cannot partition a pallas_call), no BOOJUM_TPU_LIMB_SWEEP
-    opt-out / force_xla override. On non-TPU backends the kernels run in
-    interpret mode and are OPT-IN (truthy BOOJUM_TPU_LIMB_SWEEP) — the
-    u64 path stays the CPU default so tier-1 wall-clock is unchanged.
-    The knob parses through transfer.env_flag's spelling set (0/false/
-    off/no, 1/true/on/yes; junk raises — a typo must never silently pick
-    a mode)."""
-    from ..utils.transfer import env_flag
+    Default ON where they are native: TPU backend, no GSPMD-mode prover
+    mesh, no BOOJUM_TPU_LIMB_SWEEP opt-out / force_xla override. Under an
+    active mesh the answer depends on HOW the mesh executes
+    (parallel/sharding.mesh_mode): the shard_map path hands each chip its
+    local block, so pallas_call never sees a sharded operand and the limb
+    kernels stay on; the legacy GSPMD path cannot partition a pallas_call
+    and keeps them off. On non-TPU backends the kernels run in interpret
+    mode and are OPT-IN (truthy BOOJUM_TPU_LIMB_SWEEP) — the u64 path
+    stays the CPU default so tier-1 wall-clock is unchanged. The knob
+    parses through transfer.env_flag_opt's spelling set (0/false/off/no,
+    1/true/on/yes; junk raises — a typo must never silently pick a
+    mode)."""
+    from ..utils.transfer import env_flag_opt
 
     try:
         backend = jax.default_backend()
@@ -88,18 +91,14 @@ def limb_sweep_enabled() -> bool:
         return False
     # the backend-dependent default makes the knob tri-state: unset means
     # "native backends only"
-    explicit = (
-        None
-        if not os.environ.get("BOOJUM_TPU_LIMB_SWEEP", "").strip()
-        else env_flag("BOOJUM_TPU_LIMB_SWEEP", False)
-    )
+    explicit = env_flag_opt("BOOJUM_TPU_LIMB_SWEEP")
     if explicit is False:
         return False
     if _FORCE_XLA[0]:
         return False
-    from ..parallel.sharding import active_mesh
+    from ..parallel.sharding import active_mesh, mesh_mode
 
-    if active_mesh() is not None:
+    if active_mesh() is not None and mesh_mode() != "shard_map":
         return False
     if backend == "tpu":
         return True

@@ -65,16 +65,25 @@ def _next_pow2(x: int) -> int:
     return c
 
 
-def enumerate_kernels(assembly, config) -> list[KernelSpec]:
-    """The shape-keyed kernel library for a fused (meshless) prove of
-    `assembly` under `config`.
+def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
+    """The shape-keyed kernel library for a fused prove of `assembly`
+    under `config` — meshless, or per-chip shard_map when a shard_map
+    mesh is active (parallel/shard_sweep.py) or `mesh_shape` names one.
+
+    `mesh_shape`: a ('col','row') device-count pair like (2, 4), or an
+    already-built Mesh — enumerates the `_sm` kernel variants (per-chip
+    iNTT/LDE + pivot + leaf sponge, coset_sweep_terms[_limb]_sm,
+    fri_fold[_limb]_k*_sm) for that mesh without one being active. Only
+    the variant this process will dispatch is enumerated, so the compile
+    ledger records exactly the dispatched set.
 
     Derivations mirror prover._prove_impl / setup.generate_setup; only
     circuit STRUCTURE is read (placements, gates, geometry, lookup
     params) — the witness values and the setup's sigma columns are never
     touched, so this runs before generate_setup. Deliberately skipped
     (cheap, query-dependent shapes): the fused query gather, streamed
-    single-column opens, and the PoW grind (host-side)."""
+    single-column opens, the replicated Merkle tail after the cap
+    all_gather, and the PoW grind (host-side)."""
     from ..merkle import leaf_digests_device, node_layers_device
     from ..field import extension as ext_f
     from ..ntt.ntt import _ext_powers_jit, ntt_kernel_specs
@@ -95,7 +104,17 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
         use_streamed_lde,
     )
     from . import prover as P
+    from ..parallel import shard_sweep as SS
+    from ..parallel.sharding import shard_map_mesh
     from ..utils import transfer as _transfer
+
+    if mesh_shape is None:
+        smm = shard_map_mesh()
+    elif isinstance(mesh_shape, (tuple, list)):
+        smm = SS.mesh_from_shape(mesh_shape)
+    else:
+        smm = mesh_shape  # an already-built Mesh
+    D = SS.mesh_devices(smm) if smm is not None else 1
 
     n = assembly.trace_len
     log_n = n.bit_length() - 1
@@ -148,6 +167,8 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     absorb_blocks: set[int] = set()
 
     def commit_specs(tag, B, streamed, mono=True):
+        if smm is not None:
+            return commit_specs_sm(tag, B, streamed, mono)
         for nm, fn, args in ntt_kernel_specs(
             B, log_n, None if streamed else L, mono=mono
         ):
@@ -157,6 +178,28 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
                 absorb_blocks.add(min(COL_BLOCK, B - i))
         else:
             add(f"{tag}:leaf_digests", leaf_digests_device, _sds(B, L, n))
+
+    def commit_specs_sm(tag, B, streamed, mono=True):
+        # the per-chip pipeline (shard_sweep.commit_pipeline_sm): local
+        # iNTT of the column stripe, then — materialized — the fused
+        # LDE + all_to_all pivot + leaf-sponge graph, or — streamed —
+        # the per-block LDE+pivot feeding the carried local sponge
+        Bp = SS.padded_cols(B, D)
+        if mono:
+            add(f"{tag}:mono_sm", SS._mono_fn(smm), _sds(Bp, n))
+        if streamed:
+            # block widths only — the per-width lde_pivot_cols spec is
+            # added ONCE per width in the shared absorb_blocks loop below
+            # (oracles share block shapes, and each lower() is a full
+            # retrace: duplicate specs would re-pay the trace bill)
+            for i in range(0, B, COL_BLOCK):
+                absorb_blocks.add(min(COL_BLOCK, B - i))
+        else:
+            use_limb = SS.leaf_limb_ok(B, N // D)
+            add(
+                f"{tag}:lde_pivot_leaf_sm",
+                SS._lde_pivot_leaf_fn(smm, L, B, use_limb), _sds(Bp, n),
+            )
 
     commit_specs("wit", B_wit, stream)
     commit_specs("s2", S, stream)
@@ -168,10 +211,18 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     # actually use: the double-buffered split pair with BOOJUM_TPU_OVERLAP
     # on (the default), the fused block graph with it off — compiling the
     # other mode's variant would be minutes of pure waste on the tunnel
-    # compiler
+    # compiler. The shard_map streamed commit always absorbs through the
+    # split _absorb_cols (streaming.double_buffered_absorb).
     overlap = _transfer.overlap_enabled()
     for b in sorted(absorb_blocks):
-        if overlap:
+        if smm is not None:
+            add(
+                f"lde_pivot_cols_b{b}_sm",
+                SS._lde_pivot_cols_fn(smm, L, b),
+                _sds(SS.padded_cols(b, D), n),
+            )
+            add(f"absorb_cols_b{b}", _absorb_cols, _sds(N, 12), _sds(N, b))
+        elif overlap:
             add(f"lde_block_cols_b{b}", _lde_block_cols, _sds(b, n), L)
             add(f"absorb_cols_b{b}", _absorb_cols, _sds(N, 12), _sds(N, b))
         else:
@@ -179,7 +230,19 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
                 f"absorb_lde_block_b{b}",
                 _absorb_lde_block, _sds(N, 12), _sds(b, n), L,
             )
-    add("node_layers", node_layers_device, _sds(N, 4), cap)
+    if smm is None:
+        add("node_layers", node_layers_device, _sds(N, 4), cap)
+    else:
+        # per-chip node layers while digest pairs stay shard-local
+        # (shard_sweep.node_layers_sm; the replicated tail past the
+        # all_gather is cheap and compiles at dispatch)
+        steps, gather = SS.node_plan(N, cap, D)
+        for cur in steps:
+            add("node_step_sm", SS._node_step_fn(smm), _sds(cur, 4))
+        if gather is not None:
+            add(
+                "node_gather_sm", SS._all_gather_fn(smm, 2), _sds(gather, 4)
+            )
 
     if overlap:
         # the chunked witness upload's on-device concatenate
@@ -228,8 +291,12 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     for tag, B in (
         ("wit", B_wit), ("setup", B_setup), ("s2", S), ("zs", 2)
     ):
-        add(f"coset_eval_{tag}", P._coset_eval_q,
-            _sds(B, n), _sds(Q, n), _i32())
+        if smm is None:
+            add(f"coset_eval_{tag}", P._coset_eval_q,
+                _sds(B, n), _sds(Q, n), _i32())
+        else:
+            add(f"coset_eval_{tag}_sm", SS._coset_eval_fn(smm, B),
+                _sds(SS.padded_cols(B, D), n), _sds(Q, n), _i32())
     mk_path = None
     if lookups and lk_mode == "general":
         mk_path = selector_paths[assembly.lookup_marker_gid()]
@@ -244,11 +311,13 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     # compile-bill regression is attributable to the right kernel
     from .pallas_sweep import limb_sweep_enabled
 
-    sweep = P._coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx)
+    sweep = P._coset_sweep_fn(
+        assembly, selector_paths, non_residues, lk_ctx, sm_mesh=smm
+    )
     sweep_name = (
         "coset_sweep_terms_limb" if limb_sweep_enabled()
         else "coset_sweep_terms"
-    )
+    ) + ("_sm" if smm is not None else "")
     add(
         sweep_name, sweep,
         _sds(B_wit, n), _sds(B_setup, n), _sds(S, n), _sds(2, n), _i32(),
@@ -296,19 +365,37 @@ def enumerate_kernels(assembly, config) -> list[KernelSpec]:
     per = max(1, P._DEEP_BLOCK_BUDGET // (N * 8))
     for i in range(0, B_q, per):
         deep_blocks.add(min(per, B_q - i))
-    for b in sorted(deep_blocks):
-        add(f"deep_block_b{b}", P._deep_block, _sds(b, N), _sds(b), _sds(b))
-    add("deep_combine", P._deep_combine, _sds(N), _sds(N),
-        _sds(B_all), _sds(B_all), _sds(B_all), _sds(B_all), pair(N))
-    extras = P._deep_extras_fn(2, num_lk, num_pi)
-    add(
-        "deep_extras", extras,
-        pair(N), _sds(2, N), _sds(2 * num_lk, N), _sds(num_pi, N),
-        pair(N), _sds(N) if lookups else _sds(1), _sds(num_pi, N),
-        pair(2), pair(num_lk), _sds(num_pi), _sds(2 + num_lk + num_pi),
-        _sds(2 + num_lk + num_pi),
-    )
-    for nm, fn, args in fri_kernel_specs(n, config):
+    if smm is not None and not (stream or stream_setup):
+        # the sm round 5: ONE shard_map graph for main sum + extras
+        # (shard_sweep.deep_codeword_sm) — the per-block meshless deep
+        # graphs are never dispatched
+        capE = 2 + num_lk + num_pi
+        add(
+            "deep_codeword_sm", SS._deep_fn(smm, 4, 2, num_lk, num_pi),
+            (_sds(B_wit, N), _sds(B_setup, N), _sds(S, N), _sds(B_q, N)),
+            _sds(B_all), _sds(B_all), _sds(B_all), _sds(B_all),
+            pair(N), pair(N), _sds(2, N), _sds(2 * num_lk, N),
+            _sds(N) if lookups else _sds(1), _sds(num_pi, N),
+            _sds(num_pi, N), _sds(num_pi), pair(2), pair(num_lk),
+            _sds(capE), _sds(capE),
+        )
+    else:
+        for b in sorted(deep_blocks):
+            add(
+                f"deep_block_b{b}", P._deep_block,
+                _sds(b, N), _sds(b), _sds(b),
+            )
+        add("deep_combine", P._deep_combine, _sds(N), _sds(N),
+            _sds(B_all), _sds(B_all), _sds(B_all), _sds(B_all), pair(N))
+        extras = P._deep_extras_fn(2, num_lk, num_pi)
+        add(
+            "deep_extras", extras,
+            pair(N), _sds(2, N), _sds(2 * num_lk, N), _sds(num_pi, N),
+            pair(N), _sds(N) if lookups else _sds(1), _sds(num_pi, N),
+            pair(2), pair(num_lk), _sds(num_pi), _sds(2 + num_lk + num_pi),
+            _sds(2 + num_lk + num_pi),
+        )
+    for nm, fn, args in fri_kernel_specs(n, config, mesh=smm):
         add(nm, fn, *args)
 
     # ---- cached domain tables (built once per geometry, but their batch
@@ -351,6 +438,7 @@ def precompile(
     max_workers: int = 8,
     ledger: CompileLedger | None = None,
     lower_only: bool = False,
+    mesh_shape=None,
 ) -> CompileLedger:
     """Lower + compile the whole kernel library, overlapping the backend
     compiles on a thread pool.
@@ -366,7 +454,7 @@ def precompile(
     if ledger is None:
         ledger = current_compile_ledger() or CompileLedger()
     with _span("precompile_enumerate"):
-        specs = enumerate_kernels(assembly, config)
+        specs = enumerate_kernels(assembly, config, mesh_shape=mesh_shape)
     _metrics.count("precompile.kernels", len(specs))
 
     lowered = []

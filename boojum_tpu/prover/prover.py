@@ -351,10 +351,23 @@ def _commit_pipeline(values, L: int, cap: int, stream: bool):
     node stack is one executable for ALL oracles of a domain size).
     Streamed mode never materializes the rate-L storage: leaf digests are
     absorbed per column block (streaming.streamed_leaf_digests_blocks),
-    one reusable (COL_BLOCK, n) graph for every block of every oracle."""
+    one reusable (COL_BLOCK, n) graph for every block of every oracle.
+
+    Under a shard_map mesh the whole pipeline delegates to
+    parallel/shard_sweep.commit_pipeline_sm: per-chip iNTT/LDE, the
+    explicit all_to_all layout pivot, per-chip leaf sponges (the fused
+    limb kernel where native) and an explicit cap all_gather — same
+    return contract, bit-identical digests."""
     from ..merkle import commit_layers_device, node_layers_device
+    from ..parallel.sharding import shard_map_mesh
     from .streaming import streamed_leaf_digests_blocks
 
+    sm_mesh = shard_map_mesh()
+    if sm_mesh is not None:
+        from ..parallel.shard_sweep import commit_pipeline_sm
+
+        with _span("commit_pipeline", stream=stream, sm=True):
+            return commit_pipeline_sm(values, L, cap, stream, sm_mesh)
     with _span("commit_pipeline", stream=stream):
         mono = monomial_from_values(values)
         _metrics.count("ntt.monomial_from_values")
@@ -467,7 +480,9 @@ def _coset_eval_q(mono_stack, scale_q, c_arr):
     return _coset_eval(mono_stack, scale_row)
 
 
-def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
+def _coset_sweep_fn(
+    assembly, selector_paths, non_residues, lk_ctx, sm_mesh=None
+):
     """Assembly-cached fused per-coset quotient TERMS graph: gate sweep +
     copy-permutation + lookup terms + 1/Z_H over already-evaluated coset
     values (the 4 group evaluations run as separate _coset_eval_q
@@ -480,33 +495,51 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
     paths) — never the assembly/setup objects, so re-witnessed clones can
     inherit it without pinning the original's witness buffers.
 
-    Two variants, cached separately per assembly (the flag can flip
-    between proves in one process — parity tests do exactly that): the
-    u64 XLA body, and the fused u32-limb Pallas kernel
-    (pallas_sweep.build_coset_terms, BOOJUM_TPU_LIMB_SWEEP) whose outputs
-    are bit-identical."""
+    Variants, cached separately per assembly keyed (limb, shard_map mesh)
+    — the flags can flip between proves in one process; parity tests do
+    exactly that. The per-coset CORE (everything after the xs/L0/1-Z_H
+    coset slicing) is one function with one signature for both
+    representations: the u64 XLA body or the fused u32-limb Pallas kernel
+    (pallas_sweep.build_coset_terms, BOOJUM_TPU_LIMB_SWEEP). Meshless, the
+    core runs under a plain jit; under a shard_map mesh it runs per chip
+    on row shards (parallel/shard_sweep.sweep_shard_map — the terms are
+    pointwise across the domain, so sharding rows changes no value)."""
     from .pallas_sweep import build_coset_terms, limb_sweep_enabled
+    from ..parallel.sharding import shard_map_mesh
 
     limb = limb_sweep_enabled()
+    if sm_mesh is None:
+        sm_mesh = shard_map_mesh()
     cache = getattr(assembly, "_coset_sweep_cache", None)
     if not isinstance(cache, dict):
         cache = {}
         assembly._coset_sweep_cache = cache
-    if limb in cache:
-        return cache[limb]
+    key = (limb, sm_mesh)
+    if key in cache:
+        return cache[key]
 
     (lookups, lk_mode, R_args, width, num_partials, chunks,
      total_alpha_terms, Cg, Ct, W, K, M, mk_path) = lk_ctx
     non_residues = tuple(int(k) for k in non_residues)
 
     if limb:
-        kernel = build_coset_terms(
+        core = build_coset_terms(
             tuple(assembly.gates),
             tuple(tuple(p) for p in selector_paths),
             assembly.geometry, lk_ctx, non_residues,
         )
+    else:
+        core = _u64_sweep_core(
+            assembly, selector_paths, non_residues, lk_ctx
+        )
 
-        def limb_body(
+    if sm_mesh is not None:
+        from ..parallel.shard_sweep import sweep_shard_map
+
+        fn = sweep_shard_map(core, sm_mesh)
+    else:
+
+        def body(
             wit_v, setup_v, s2_v, zs_v, c_arr,
             xs_q, l0_q, zhinv_q, ap0, ap1, beta01, gamma01, lkb01, lkg01,
         ):
@@ -515,16 +548,26 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
             xs_sl = jax.lax.dynamic_slice_in_dim(xs_q, start, n)
             l0_sl = jax.lax.dynamic_slice_in_dim(l0_q, start, n)
             zhinv_sl = jax.lax.dynamic_slice_in_dim(zhinv_q, start, n)
-            return kernel(
+            return core(
                 wit_v, setup_v, s2_v, zs_v, xs_sl, l0_sl, zhinv_sl,
                 ap0, ap1, beta01, gamma01, lkb01, lkg01,
             )
 
-        fn = jax.jit(limb_body)
-        cache[limb] = fn
-        return fn
+        fn = jax.jit(body)
+    cache[key] = fn
+    return fn
 
+
+def _u64_sweep_core(assembly, selector_paths, non_residues, lk_ctx):
+    """The emulated-u64 per-coset terms core, signature-identical to the
+    limb kernel (pallas_sweep.build_coset_terms): consumes pre-sliced
+    xs/L0/1-Z_H coset rows so the same core serves the meshless jit and
+    the per-chip shard_map body."""
     from .stages import _build_gate_sweep
+
+    (lookups, lk_mode, R_args, width, num_partials, chunks,
+     total_alpha_terms, Cg, Ct, W, K, M, mk_path) = lk_ctx
+    non_residues = tuple(int(k) for k in non_residues)
 
     total_gate_terms = num_gate_sweep_terms(assembly)
     gate_fn = getattr(assembly, "_gate_sweep_jit", None)
@@ -535,17 +578,12 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
         )
         assembly._gate_sweep_jit = gate_fn
 
-    def body(
-        wit_v, setup_v, s2_v, zs_v, c_arr,
-        xs_q, l0_q, zhinv_q, ap0, ap1, beta01, gamma01, lkb01, lkg01,
+    def core(
+        wit_v, setup_v, s2_v, zs_v, xs_sl, l0_sl, zhinv_sl,
+        ap0, ap1, beta01, gamma01, lkb01, lkg01,
     ):
         from .stages import AlphaPows as AP
 
-        n = wit_v.shape[-1]
-        start = c_arr * n
-        xs_sl = jax.lax.dynamic_slice_in_dim(xs_q, start, n)
-        l0_sl = jax.lax.dynamic_slice_in_dim(l0_q, start, n)
-        zhinv_sl = jax.lax.dynamic_slice_in_dim(zhinv_q, start, n)
         copy_v = wit_v[:Ct]
         gate_wit_v = wit_v[Ct : Ct + W] if W else None
         sigma_v = setup_v[:Ct]
@@ -602,9 +640,21 @@ def _coset_sweep_fn(assembly, selector_paths, non_residues, lk_ctx):
             acc = ext_f.add(acc, lk_acc)
         return gf.mul(acc[0], zhinv_sl), gf.mul(acc[1], zhinv_sl)
 
-    fn = jax.jit(body)
-    cache[limb] = fn
-    return fn
+    return core
+
+
+def _gspmd_demesh_ok() -> bool:
+    """Whether the GSPMD u64-miscompile hardening (rounds 4-5 de-mesh,
+    replicated query gathers) can apply: single-process meshes only.
+    jax.device_put onto one device — or onto a replicated NamedSharding —
+    needs every device addressable, which fails across jax.distributed;
+    there the sharded round 4-5 graphs stay as before this hardening (the
+    multi-host GSPMD prove was validated bit-exact on hardware without
+    it — the miscompile was observed on the forced-8-device CPU mesh)."""
+    try:
+        return jax.process_count() == 1
+    except Exception:
+        return True
 
 
 @partial(jax.jit, static_argnums=(2, 3))
@@ -951,9 +1001,15 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     lp = assembly.lookup_params
     TW = (lp.width + 1) if lookups else 0  # table setup columns
 
-    from ..parallel.sharding import active_mesh, shard_cols
+    from ..parallel.sharding import active_mesh, shard_cols, shard_map_mesh
 
-    fused = active_mesh() is None
+    # Mesh execution comes in two flavors (parallel/sharding.mesh_mode):
+    # the shard_map path runs the FUSED round graphs with per-chip native
+    # kernels and explicit collectives (parallel/shard_sweep.py), so it
+    # shares the fused control flow below; the legacy GSPMD path keeps the
+    # sequenced branches (its smaller jits are what GSPMD partitions).
+    sm_mesh = shard_map_mesh()
+    fused = active_mesh() is None or sm_mesh is not None
 
     def _upload_witness():
         host_cols = [np.asarray(assembly.copy_cols_values)]
@@ -970,8 +1026,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # streamed commit-rate mode: above the footprint threshold the rate-L
     # storages are never materialized — commits absorb column blocks into a
     # carried sponge state, DEEP/queries regenerate blocks from monomials
-    # (see prover/streaming.py). Mesh runs keep the materialized path (its
-    # sharding constraints pool HBM across chips).
+    # (see prover/streaming.py). GSPMD mesh runs keep the materialized path
+    # (its sharding constraints pool HBM across chips); shard_map mesh runs
+    # stream per chip — each chip absorbs its own row range
+    # (shard_sweep.streamed_leaf_digests_sm).
     num_chunks_est = len(
         chunk_columns(Ct, geometry.max_allowed_constraint_degree)
     )
@@ -1282,8 +1340,39 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # drive the 2^20 ceiling — bench.py at large traces,
         # scripts/sha2_20_driver.py — set it themselves).
         _sync_sweeps = _transfer.env_flag("BOOJUM_TPU_SYNC_SWEEPS", False)
+        if sm_mesh is not None:
+            # pad + column-shard the four monomial groups ONCE per round
+            # (not per coset); each coset evaluation then runs the
+            # per-chip scale+NTT and pivots to row sharding with one
+            # explicit all_to_all (parallel/shard_sweep.py)
+            from ..parallel.shard_sweep import (
+                coset_eval_q_sm,
+                pad_cols_sharded,
+            )
+
+            _eval_groups = {
+                "wit": pad_cols_sharded(wit_mono, sm_mesh),
+                "setup": pad_cols_sharded(setup.setup_monomials, sm_mesh),
+                "s2": pad_cols_sharded(s2_mono, sm_mesh),
+                "zs": pad_cols_sharded(zs_mono, sm_mesh),
+            }
+
+            def _eval_group(tag, mono_stack, ci):
+                return coset_eval_q_sm(
+                    _eval_groups[tag], scale_q, ci,
+                    int(mono_stack.shape[0]), sm_mesh,
+                )
+
+        else:
+
+            def _eval_group(tag, mono_stack, ci):
+                return _coset_eval_q(mono_stack, scale_q, ci)
+
         T_parts0, T_parts1 = [], []
-        with _span("round3_coset_sweeps", cosets=Q, limb=_limb_sweep):
+        with _span(
+            "round3_coset_sweeps", cosets=Q, limb=_limb_sweep,
+            sm=sm_mesh is not None,
+        ):
             for c in range(Q):
                 ci = jnp.int32(c)
                 _metrics.count("ntt.coset_evals", 4)
@@ -1293,10 +1382,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                     # count makes "which representation ran" auditable
                     # per report
                     _metrics.count("quotient.limb_coset_sweeps")
-                wit_v = _coset_eval_q(wit_mono, scale_q, ci)
-                setup_v = _coset_eval_q(setup.setup_monomials, scale_q, ci)
-                s2_v = _coset_eval_q(s2_mono, scale_q, ci)
-                zs_v = _coset_eval_q(zs_mono, scale_q, ci)
+                wit_v = _eval_group("wit", wit_mono, ci)
+                setup_v = _eval_group("setup", setup.setup_monomials, ci)
+                s2_v = _eval_group("s2", s2_mono, ci)
+                zs_v = _eval_group("zs", zs_mono, ci)
                 t0c, t1c = sweep(
                     wit_v, setup_v, s2_v, zs_v,
                     ci, xs_q, l0_q, zh_inv_q,
@@ -1310,9 +1399,18 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 T_parts0.append(t0c)
                 T_parts1.append(t1c)
             _sync_point(T_parts1, "round3_sweeps")
-        q_mono, q_lde, layers = _quotient_tail_fused(
-            tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
-        )
+        if sm_mesh is not None:
+            del _eval_groups
+            from ..parallel.shard_sweep import commit_from_mono_sm
+
+            q_mono = _quotient_interp(
+                tuple(T_parts0), tuple(T_parts1), Q, n
+            )
+            q_lde, layers = commit_from_mono_sm(q_mono, L, cap, sm_mesh)
+        else:
+            q_mono, q_lde, layers = _quotient_tail_fused(
+                tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
+            )
         del T_parts0, T_parts1
         if overlap:
             _transfer.prefetch_async(layers[-1])
@@ -1406,7 +1504,21 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 4: evaluations at z (and z*omega, 0) ----------------------
     clock.start("round4_evaluations")
-    all_mono = jnp.concatenate([wit_mono, setup.setup_monomials, s2_mono, q_mono])
+    _setup_mono = setup.setup_monomials
+    if active_mesh() is not None and sm_mesh is None and _gspmd_demesh_ok():
+        # GSPMD only: the partitioner's u64 miscompile (see the round-5
+        # de-mesh below) can also land on the z-evaluation contraction
+        # over the sharded monomial stacks — pull them onto one device
+        # BEFORE the concat so rounds 4-5 run the single-device graphs.
+        # The committed heavy phases (rounds 1-3) keep their GSPMD
+        # sharding; their caps are transcript-checked bit-exact.
+        from ..parallel.shard_sweep import demesh as _demesh
+
+        wit_mono = _demesh(wit_mono)
+        s2_mono = _demesh(s2_mono)
+        q_mono = _demesh(q_mono)
+        _setup_mono = _demesh(_setup_mono)
+    all_mono = jnp.concatenate([wit_mono, _setup_mono, s2_mono, q_mono])
     B = all_mono.shape[0]
     zw = ext_f.mul_by_base_s(z_chal, omega)
     deep_prep = None
@@ -1481,6 +1593,31 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     def _col(src, i):
         return src.column(i) if isinstance(src, MonomialSource) else src[i]
 
+    if (
+        active_mesh() is not None
+        and shard_map_mesh() is None
+        and _gspmd_demesh_ok()
+    ):
+        # GSPMD only: XLA's SPMD partitioner miscompiles the u64 round-5
+        # math over mesh-sharded operands (first divergence of the whole
+        # prove lands on fri_cap_0 — the h/t codeword itself comes out
+        # wrong on the forced-8-device CPU mesh; rounds 1-4, whose caps
+        # hash the SAME LDE arrays, match bit-for-bit, and replicating
+        # the operands is NOT enough — the partitioned batch-inverse scan
+        # still diverges). Pull every round-5 input onto one device so
+        # DEEP + FRI run the single-device graphs — correctness over
+        # speed on the legacy path; the shard_map mode is the performant
+        # mesh path.
+        from ..parallel.shard_sweep import demesh as _demesh
+
+        wit_lde_all = _demesh(wit_lde_all)
+        setup_lde_flat = _demesh(setup_lde_flat)
+        s2_lde_flat = _demesh(s2_lde_flat)
+        q_lde = _demesh(q_lde)
+        xs_lde = _demesh(xs_lde)
+        if deep_prep is not None:
+            deep_prep = {k: _demesh(v) for k, v in deep_prep.items()}
+
     deep_sources = [
         wit_lde_all,
         setup_lde_flat,
@@ -1518,16 +1655,6 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             )
         inv_xz = deep_prep["inv_xz"]
         inv_xzw = deep_prep["inv_xzw"]
-        h = _deep_main_sum(deep_sources, y0s, y1s, c0s, c1s, inv_xz)
-        # the remaining terms (z at z*omega, lookup sums at 0, public
-        # inputs): the gathered columns, then ONE fused accumulation
-        s2_cols = deep_prep["s2_cols"]
-        cols_zw = s2_cols[:2]
-        cols_lk = s2_cols[2:]
-        inv_x = deep_prep["inv_x"]
-        cols_pi = deep_prep["cols_pi"]
-        pi_denoms = deep_prep["pi_denoms"]
-        pi_vals = deep_prep["pi_vals"]
         ch0e, ch1e = deep_pows.take(2 + num_lk + num_pi)
         y_zw = (
             jnp.asarray(np.array([v[0] for v in values_at_z_omega], dtype=np.uint64)),
@@ -1537,11 +1664,51 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             jnp.asarray(np.array([v[0] for v in values_at_0], dtype=np.uint64)),
             jnp.asarray(np.array([v[1] for v in values_at_0], dtype=np.uint64)),
         )
-        extras = _deep_extras_fn(2, num_lk, num_pi)
-        h = extras(
-            h, cols_zw, cols_lk, cols_pi, inv_xzw, inv_x, pi_denoms,
-            y_zw, y_lk0, pi_vals, ch0e, ch1e,
+        _streamed_deep = any(
+            isinstance(s, MonomialSource) for s in deep_sources
         )
+        if sm_mesh is not None and not _streamed_deep:
+            # the whole DEEP accumulation is pointwise across the domain:
+            # one shard_map graph computes main sum + extras per chip on
+            # its N/D slice (the col->row re-layout of the sources at its
+            # boundary is charged to ici.*), and h comes out row-sharded
+            # — the layout the per-chip FRI commit/fold
+            # graphs consume (shard_sweep.deep_codeword_sm; also dodges
+            # the SPMD-partitioner u64 miscompile a plain jit over the
+            # sharded LDE operands hits)
+            from ..parallel.shard_sweep import deep_codeword_sm
+
+            h = deep_codeword_sm(
+                sm_mesh, deep_sources, y0s, y1s, c0s, c1s, inv_xz,
+                deep_prep, y_zw, y_lk0, ch0e, ch1e, 2, num_lk, num_pi,
+            )
+        else:
+            if sm_mesh is not None:
+                # streamed oracles regenerate their blocks inside plain
+                # jits — de-mesh the round-5 inputs so those jits stay
+                # off the partitioner (correctness fallback; the commit/
+                # sweep/fold phases already ran per chip)
+                from ..parallel.shard_sweep import demesh as _demesh
+
+                deep_sources = [_demesh(s) for s in deep_sources]
+                deep_prep = {k: _demesh(v) for k, v in deep_prep.items()}
+                inv_xz = deep_prep["inv_xz"]
+                inv_xzw = deep_prep["inv_xzw"]
+            h = _deep_main_sum(deep_sources, y0s, y1s, c0s, c1s, inv_xz)
+            # the remaining terms (z at z*omega, lookup sums at 0, public
+            # inputs): the gathered columns, then ONE fused accumulation
+            s2_cols = deep_prep["s2_cols"]
+            cols_zw = s2_cols[:2]
+            cols_lk = s2_cols[2:]
+            inv_x = deep_prep["inv_x"]
+            cols_pi = deep_prep["cols_pi"]
+            pi_denoms = deep_prep["pi_denoms"]
+            pi_vals = deep_prep["pi_vals"]
+            extras = _deep_extras_fn(2, num_lk, num_pi)
+            h = extras(
+                h, cols_zw, cols_lk, cols_pi, inv_xzw, inv_x, pi_denoms,
+                y_zw, y_lk0, pi_vals, ch0e, ch1e,
+            )
     else:
         # 1/(x - z), 1/(x - z*omega) over the domain (ext)
         x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),
@@ -1669,6 +1836,33 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ONE fused gather dispatch + ONE host transfer
     arrs_, idxs_, axes_ = zip(*plans)
+    if (
+        active_mesh() is not None
+        and shard_map_mesh() is None
+        and _gspmd_demesh_ok()
+    ):
+        # GSPMD only: XLA's SPMD partitioner miscompiles u64 gathers over
+        # partially-replicated operands (replica values get SUMMED — 2x
+        # leaf values observed on the forced-8-device CPU mesh, alongside
+        # its "involuntary full rematerialization" warning). Gather from
+        # explicitly replicated copies instead; the shard_map path keeps
+        # its layouts (its gathers came out bit-exact).
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        _rep = NamedSharding(active_mesh(), PartitionSpec())
+        arrs_ = tuple(jax.device_put(a, _rep) for a in arrs_)
+    elif shard_map_mesh() is not None and any(
+        len(a.devices()) <= 1 for a in arrs_
+    ):
+        # streamed sm proves mix placements here: commit-phase node
+        # layers live on the mesh while the de-meshed round-5/FRI chain
+        # left its layers on one device — one jit cannot take both.
+        # These are the small node/cap layers (the big leaf gathers went
+        # through the MonomialSource path above), so pull them all onto
+        # one device and gather there.
+        from ..parallel.shard_sweep import demesh as _demesh
+
+        arrs_ = tuple(_demesh(a) for a in arrs_)
     _metrics.count("query.gather_plans", len(plans))
     with _span("query_gather"):
         flat = host_np(

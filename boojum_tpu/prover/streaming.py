@@ -150,23 +150,37 @@ def streamed_leaf_digests_blocks(mono, L: int):
             blk = jax.lax.dynamic_slice_in_dim(mono, i, b, axis=0)
             state = _absorb_lde_block(state, blk, L)
         return state[:, :4]
-    from ..utils import metrics as _metrics
-
-    starts = list(range(0, B, COL_BLOCK))
 
     def _lde(i):
         b = min(COL_BLOCK, B - i)
         blk = jax.lax.dynamic_slice_in_dim(mono, i, b, axis=0)
         return _lde_block_cols(blk, L)
 
-    nxt = _lde(starts[0])
-    for k, _i in enumerate(starts):
+    return double_buffered_absorb(
+        state, range(0, B, COL_BLOCK), _lde
+    )[:, :4]
+
+
+def double_buffered_absorb(state, starts, produce_cols):
+    """The double-buffered absorb loop shared by the meshless streamed
+    commit above and the per-chip shard_map one
+    (parallel/shard_sweep.streamed_leaf_digests_sm): block b+1's leaf
+    columns (an LDE — and on the mesh, its pivot collective) are enqueued
+    BEFORE block b's absorb, so the device pipelines transforms against
+    the serial sponge chain. `produce_cols(start)` must return the (N, b)
+    leaf columns for the block at `start`; absorb order — and therefore
+    every digest — is identical to the sequential loop."""
+    from ..utils import metrics as _metrics
+
+    starts = list(starts)
+    nxt = produce_cols(starts[0])
+    for k in range(len(starts)):
         cols, nxt = nxt, (
-            _lde(starts[k + 1]) if k + 1 < len(starts) else None
+            produce_cols(starts[k + 1]) if k + 1 < len(starts) else None
         )
         _metrics.count("stream.double_buffered_blocks")
         state = _absorb_cols(state, cols)
-    return state[:, :4]
+    return state
 
 
 from functools import partial as _partial

@@ -158,6 +158,28 @@ def count_bytes_d2h(nbytes: int):
         reg.count("transfer.d2h_ops")
 
 
+def count_ici_all_to_all(crossing_bytes: float):
+    """Tally one explicit all-to-all layout pivot on the shard_map mesh
+    (parallel/shard_sweep.py). `crossing_bytes` is the portion of the
+    global payload that actually crosses the interconnect — the caller
+    owns the (D-1)/D topology math, this seam owns the gauge names:
+    `ici.all_to_alls` / `ici.all_to_all_bytes` (and `ici.pivot_s` for the
+    dispatch window, charged by shard_sweep's pivot timer)."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count("ici.all_to_alls")
+        reg.gauge_add("ici.all_to_all_bytes", crossing_bytes)
+
+
+def count_ici_all_gather(crossing_bytes: float):
+    """Tally one explicit all-gather to replicated (caps, small node
+    layers): `ici.all_gathers` / `ici.all_gather_bytes`."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count("ici.all_gathers")
+        reg.gauge_add("ici.all_gather_bytes", crossing_bytes)
+
+
 def stage_boundary(label: str):
     reg = _REGISTRY
     if reg is not None:

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 
 _FORCE_XLA = [False]
+_LOCAL_OPERANDS = [False]
 
 
 class force_xla:
@@ -31,28 +32,66 @@ class force_xla:
         return False
 
 
+class local_operands:
+    """Trace-time marker that the dispatchers below are seeing per-chip
+    LOCAL blocks — the bodies of parallel/shard_sweep.py's shard_map
+    graphs enter it while they trace, so `pallas_enabled` can skip its
+    active-mesh veto (that veto exists for PLAIN jits over mesh-sharded
+    operands, which GSPMD cannot hand to a pallas_call). Same idiom as
+    `force_xla`; force_xla still wins when both are active."""
+
+    def __enter__(self):
+        self._prev = _LOCAL_OPERANDS[0]
+        _LOCAL_OPERANDS[0] = True
+        return self
+
+    def __exit__(self, *exc):
+        _LOCAL_OPERANDS[0] = self._prev
+        return False
+
+
 def pallas_enabled(opt_in_env: str | None = None) -> bool:
     """True when the fused TPU kernels should be used.
 
     Requires the TPU backend, no active prover mesh (the sharded pipeline
     keeps plain XLA ops so GSPMD can partition them — pallas_call does not
-    split under a NamedSharding), and no BOOJUM_TPU_PALLAS=0 override.
-    With `opt_in_env`, additionally requires that env var to be "1" (used
-    by kernels that currently trail the XLA path and are opt-in)."""
+    split under a NamedSharding; shard_map bodies announce their per-chip
+    blocks via `local_operands` and keep the kernels), and no
+    BOOJUM_TPU_PALLAS=0 override. With `opt_in_env`, additionally requires
+    that env var to be "1" (used by kernels that currently trail the XLA
+    path and are opt-in)."""
     if opt_in_env is not None and os.environ.get(opt_in_env, "0") != "1":
         return False
     if _FORCE_XLA[0]:
         return False
-    if os.environ.get("BOOJUM_TPU_PALLAS", "").strip() == "0":
+    from .transfer import env_flag
+
+    if not env_flag("BOOJUM_TPU_PALLAS", True):
         return False
     try:
         if jax.default_backend() != "tpu":
             return False
     except Exception:
         return False
+    if _LOCAL_OPERANDS[0]:
+        return True
     from ..parallel.sharding import active_mesh
 
     return active_mesh() is None
+
+
+def tpu_compiler_params(vmem_limit_bytes: int):
+    """A pltpu CompilerParams instance tolerating both pallas API
+    generations (`CompilerParams` was `TPUCompilerParams` before jax 0.5),
+    or None when neither exists — so interpret-mode fallback, which the
+    shard_map mesh path uses for CPU parity tests, imports everywhere.
+    Shared by the Poseidon2 / limb-sweep / MXU-NTT kernel modules."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams", None
+    )
+    return cls(vmem_limit_bytes=vmem_limit_bytes) if cls else None
 
 
 def pick_tile(R: int, budget_rows: int) -> int:

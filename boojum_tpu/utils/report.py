@@ -307,6 +307,55 @@ def validate_report(report: dict) -> list[str]:
     metrics = report.get("metrics")
     if not isinstance(metrics, dict) or "counters" not in metrics:
         problems.append("metrics missing or malformed")
+    else:
+        # ici.* — the explicit mesh collectives' bill (ISSUE 5). Every
+        # gauge must be a finite non-negative number, and a nonzero
+        # collective COUNTER must come with its byte gauge (and the pivot
+        # timer for all_to_alls): a pivot that moved zero bytes means the
+        # accounting seam in parallel/shard_sweep.py was bypassed.
+        counters = metrics.get("counters")
+        if not isinstance(counters, dict):
+            if counters is not None:
+                problems.append(
+                    "metrics.counters malformed: "
+                    f"{type(counters).__name__}"
+                )
+            counters = {}
+        gauges = metrics.get("gauges")
+        if not isinstance(gauges, dict):
+            if gauges is not None:
+                problems.append(
+                    f"metrics.gauges malformed: {type(gauges).__name__}"
+                )
+            gauges = {}
+
+        def _num(v):
+            # non-numerics were flagged above; compare as 0 so one bad
+            # value yields its problem line instead of a TypeError
+            return v if isinstance(v, (int, float)) and v == v else 0
+
+        for k, v in gauges.items():
+            if not k.startswith("ici."):
+                continue
+            if not isinstance(v, (int, float)) or v != v or v < 0:
+                problems.append(f"gauge {k}: invalid value {v!r}")
+        if _num(counters.get("ici.all_to_alls", 0)) > 0:
+            if not _num(gauges.get("ici.all_to_all_bytes", 0)) > 0:
+                problems.append(
+                    "ici.all_to_alls counted but ici.all_to_all_bytes "
+                    "gauge is missing/zero"
+                )
+            if "ici.pivot_s" not in gauges:
+                problems.append(
+                    "ici.all_to_alls counted but ici.pivot_s gauge missing"
+                )
+        if _num(counters.get("ici.all_gathers", 0)) > 0 and not _num(
+            gauges.get("ici.all_gather_bytes", 0)
+        ) > 0:
+            problems.append(
+                "ici.all_gathers counted but ici.all_gather_bytes "
+                "gauge is missing/zero"
+            )
     return problems
 
 

@@ -142,6 +142,18 @@ def stop_recording() -> SpanRecorder | None:
     return install_recorder(None)
 
 
+def span_attr(name: str, value):
+    """Attach an attribute to the CURRENTLY OPEN span (no-op when nothing
+    records) — for call sites that learn something mid-span worth auditing
+    per report, e.g. which axis shard_cols actually sharded."""
+    rec = _RECORDER
+    if rec is None:
+        return
+    sp = rec.current()
+    if sp is not None:
+        sp.setdefault("attrs", {})[name] = value
+
+
 @contextlib.contextmanager
 def span(name: str, stage: bool = False, **attrs):
     """Record one span. Yields the span dict (or None when not recording).

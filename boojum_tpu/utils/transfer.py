@@ -59,6 +59,16 @@ def env_flag(name: str, default: bool) -> bool:
     )
 
 
+def env_flag_opt(name: str) -> bool | None:
+    """Tri-state form of `env_flag`: True/False for an explicit setting,
+    None when the variable is unset/empty (callers supply a context-
+    dependent default, e.g. pallas_sweep's backend-dependent dispatch).
+    Same spelling set, same raise-on-junk contract."""
+    if not os.environ.get(name, "").strip():
+        return None
+    return env_flag(name, False)
+
+
 def overlap_enabled() -> bool:
     """BOOJUM_TPU_OVERLAP: default ON; 0/false/off/no disables (the fully
     sequenced transfer order), 1/true/on/yes forces on."""

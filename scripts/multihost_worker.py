@@ -121,6 +121,22 @@ def main():
 
     if report_path is not None:
         result["prove_report_path"] = report_path
+        # surface the explicit-collective bill (ISSUE 5) on the per-host
+        # line itself: the ici.* gauges/counters of the LAST prove of this
+        # host, so multi-host runs are triageable without opening every
+        # ProveReport artifact
+        try:
+            with open(report_path) as f:
+                lines = [ln for ln in f if ln.strip()]
+            metrics = json.loads(lines[-1]).get("metrics") or {}
+            result["ici"] = {
+                k: v
+                for src in ("gauges", "counters")
+                for k, v in (metrics.get(src) or {}).items()
+                if k.startswith("ici.")
+            }
+        except (OSError, ValueError, IndexError):
+            result["ici"] = {}
 
     with open(out_path, "w") as f:
         json.dump(result, f)

@@ -126,6 +126,70 @@ def test_limb_sweep_kernels_enumerate_and_lower(monkeypatch):
     assert "coset_sweep_terms_limb" not in names_u64
 
 
+def test_mesh_shard_map_kernels_enumerate_and_lower(monkeypatch):
+    """ISSUE 5 satellite: enumerate_kernels(mesh_shape=(2,4)) swaps in the
+    shard_map `_sm` kernel variants (per-chip iNTT + fused LDE/pivot/leaf
+    graph, per-coset eval with explicit all_to_all, the sm terms sweep,
+    the per-chip FRI leaf/fold graphs, the one-graph DEEP codeword), they
+    LOWER on the forced-8-device CPU, and the ledger records ONLY the
+    dispatched variant — none of the meshless twins ride along."""
+    import jax as _jax
+
+    if len(_jax.devices()) < 8:
+        import pytest
+
+        pytest.skip("needs 8 virtual devices")
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.prover.precompile import enumerate_kernels, precompile
+
+    monkeypatch.delenv("BOOJUM_TPU_LIMB_SWEEP", raising=False)
+    geom = CSGeometry(8, 0, 6, 4)
+    cs = ConstraintSystem(geom, 1 << 10)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geom)
+    for _ in range(((1 << 10) - 8) * per_row):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    asm = cs.into_assembly()
+    cfg = ProofConfig(
+        fri_lde_factor=2,
+        merkle_tree_cap_size=4,
+        num_queries=4,
+        fri_final_degree=16,
+    )
+    specs = enumerate_kernels(asm, cfg, mesh_shape=(2, 4))
+    names = [s.name for s in specs]
+    for want in (
+        "wit:mono_sm", "wit:lde_pivot_leaf_sm", "coset_eval_wit_sm",
+        "coset_sweep_terms_sm", "deep_codeword_sm",
+    ):
+        assert want in names, names
+    assert any(n.startswith("fri_leaf_k") and n.endswith("_sm")
+               for n in names), names
+    assert any(n.startswith("fri_fold_k") and n.endswith("_sm")
+               for n in names), names
+    # only the dispatched variant: the meshless twins must be absent
+    assert "coset_sweep_terms" not in names
+    assert "coset_eval_wit" not in names
+    assert not any(
+        n.startswith("fri_commit_k") for n in names
+    ), "meshless FRI commit enumerated alongside the sm one"
+    assert "deep_combine" not in names
+    assert "node_layers" not in names
+
+    ledger = CompileLedger()
+    precompile(asm, cfg, ledger=ledger, lower_only=True, mesh_shape=(2, 4))
+    by_name = {e["name"]: e for e in ledger.entries}
+    for name in names:
+        assert name in by_name, name
+        assert "error" not in by_name[name], by_name[name]
+
+    # meshless enumeration is untouched: no _sm names
+    names0 = [s.name for s in enumerate_kernels(asm, cfg)]
+    assert not any(n.endswith("_sm") for n in names0)
+
+
 # ---------------------------------------------------------------------------
 # Pre-split monolithic forms, kept verbatim as parity oracles
 # ---------------------------------------------------------------------------
