@@ -27,7 +27,7 @@ rides along on every JSON line and is written to BENCH_LEDGER_JSON, so a
 timeout is diagnosable from the JSON alone and compile-bill regressions
 are visible across rounds.
 
-Usage: python bench.py [--precompile-only] [--no-precompile]
+Usage: python bench.py [--precompile-only] [--no-precompile] [--service]
   --precompile-only runs synthesis + the parallel precompile, emits the
   ledger JSON line and exits — a cache-warming step to run before a bench
   or a multihost round.
@@ -35,6 +35,14 @@ Usage: python bench.py [--precompile-only] [--no-precompile]
   sweep runs BY DEFAULT before the warm-up prove: round 4's watchdog
   burned the whole budget on serial cold compiles, so BENCH lines never
   measured a prove; equivalent to BENCH_PRECOMPILE=0).
+  --service measures THROUGHPUT instead of single-proof wall: after the
+  warm-up prove, BENCH_SERVICE_REQS requests (default 4) of the bench
+  circuit drain through the boojum_tpu/service/ scheduler
+  (shape-bucketed queue, device-resident caches, shard- vs
+  proof-parallel placement) and the JSON line's metric becomes
+  <circuit>_service_proofs_per_sec with the service summary (placements,
+  queue, cache hits/evictions) attached — so BENCH rounds can track
+  proofs/sec, not just prove wall. BOOJUM_TPU_SERVICE_* flags apply.
 
 Environment knobs:
   BENCH_CIRCUIT = sha256 (default) | fma
@@ -216,8 +224,11 @@ _LEDGER = _start_ledger()
 
 _STATE = {
     "metric": None,
+    "unit": "s",
     "phase": "import",
-    "reps": [],           # completed timed rep walls
+    "reps": [],           # completed timed rep walls (service mode:
+                          # the single proofs/sec figure)
+    "service": None,      # --service: the service drain summary
     "warm_wall": None,    # warm-up (first, compile-laden) prove wall
     "stages": {},         # per-stage split of the reported rep (the warm-up
                           # split until the first timed rep lands, so EVERY
@@ -377,7 +388,7 @@ def _emit(status):
         out = {
             "metric": _STATE["metric"] or "sha256_8192B_prove_wall",
             "value": round(value, 4),
-            "unit": "s",
+            "unit": _STATE["unit"],
             "vs_baseline": _vs_baseline(value),
             "schema": _LINE_SCHEMA,
             "status": status,
@@ -386,6 +397,8 @@ def _emit(status):
             "stages": _STATE["stages"] or _live_stage_split(),
             "peak_mem": _STATE["peak_mem"],
         }
+        if _STATE["service"] is not None:
+            out["service"] = _STATE["service"]
         if status != "ok":
             # a watchdog/failure line localizes the stall: the partial
             # hierarchical span tree of the prove in flight (open spans
@@ -633,6 +646,56 @@ def main():
     _log(f"warm-up prove done in {_STATE['warm_wall']}s; verifying")
     _STATE["phase"] = "verify"
     assert verify(setup.vk, proof, asm.gates)
+
+    if "--service" in sys.argv:
+        # throughput mode: drain BENCH_SERVICE_REQS requests through the
+        # proving service (shape-bucketed queue, device-resident caches,
+        # scheduler-picked placement) and report proofs/sec — the number
+        # BENCH rounds need once single-proof wall stops being the
+        # bottleneck. The warm-up prove above already validated parity
+        # and warmed the caches the service will hit.
+        _STATE["phase"] = "service_drain"
+        from boojum_tpu.service import ProvingService, ServiceConfig
+
+        scfg = ServiceConfig.from_env()
+        if not os.environ.get("BOOJUM_TPU_SERVICE_PRECOMPILE", "").strip():
+            # the bench's own precompile sweep already filled the cache
+            # for the variant a meshless/proof-parallel drain dispatches
+            scfg.precompile = "off"
+        svc = ProvingService(scfg)
+        nreq = int(os.environ.get("BENCH_SERVICE_REQS", "4"))
+        _log(
+            f"service drain: {nreq} requests, "
+            f"mesh={None if svc.mesh is None else dict(svc.mesh.shape)}"
+        )
+        requests = [
+            svc.submit(
+                asm, setup, config,
+                priority="interactive" if i == nreq - 1 else "batch",
+            )
+            for i in range(nreq)
+        ]
+        summary = svc.run_worker()
+        assert summary["failed"] == 0, summary
+        for r in requests:
+            r.result(timeout=1.0)
+        pps = summary.get("proofs_per_sec") or 0.0
+        _log(f"service drain done: {json.dumps(summary)}")
+        with _EMIT_LOCK:
+            if not _STATE["done"]:
+                base = (_STATE["metric"] or "prove_wall").replace(
+                    "_prove_wall", ""
+                )
+                _STATE["metric"] = f"{base}_service_proofs_per_sec"
+                _STATE["unit"] = "proofs/s"
+                _STATE["reps"] = [pps]
+                _STATE["service"] = summary
+        stop_collecting_stages()
+        if not os.environ.get("BENCH_SKIP_NTT"):
+            _STATE["phase"] = "ntt_metric"
+            _measure_ntt()
+        _emit("ok")
+        return
 
     _STATE["phase"] = "timed_reps"
     rep_stages = []

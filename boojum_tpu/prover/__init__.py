@@ -10,3 +10,4 @@ from .convenience import (
     verify_circuit,
 )
 from .precompile import enumerate_kernels, precompile
+from .shape_key import ShapeBucket, bucket_key, shape_bucket

@@ -89,11 +89,11 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
     from ..ntt.ntt import _ext_powers_jit, ntt_kernel_specs
     from .fri import fri_kernel_specs
     from .setup import build_selector_tree, non_residues_for_copy_permutation
+    from .shape_key import shape_bucket
     from .stages import (
         _all_chunk_num_den,
         _lookup_denominators,
         _z_and_partials,
-        chunk_columns,
         num_gate_sweep_terms,
     )
     from .streaming import (
@@ -116,42 +116,31 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
         smm = mesh_shape  # an already-built Mesh
     D = SS.mesh_devices(smm) if smm is not None else 1
 
-    n = assembly.trace_len
-    log_n = n.bit_length() - 1
-    L = config.fri_lde_factor
-    N = n * L
-    cap = config.merkle_tree_cap_size
-    geometry = assembly.geometry
-    Cg = assembly.copy_placement.shape[0]
-    LC = assembly.num_lookup_cols
-    Ct = Cg + LC
-    W = assembly.wit_placement.shape[0]
-    lookups = assembly.lookups_enabled
+    # ONE derivation of every shape-keyed quantity, shared with the
+    # service admission queue and the compile-ledger tags (shape_key.py)
+    sb = shape_bucket(assembly, config)
+    n = sb.trace_len
+    log_n = sb.log_n
+    L = sb.lde_factor
+    N = sb.domain_len
+    cap = sb.cap_size
+    Cg, LC, Ct, W = sb.num_copy_cols, sb.num_lookup_cols, sb.Ct, sb.num_wit_cols
+    lookups = sb.lookups
     lk_mode = assembly.lookup_mode
-    R_args = assembly.num_lookup_subargs
-    M = 1 if lookups else 0
-    K = geometry.num_constant_columns + (1 if lk_mode == "specialized" else 0)
-    lp = assembly.lookup_params
-    TW = (lp.width + 1) if lookups else 0
-    width = lp.width if lookups else 0
+    R_args = sb.lookup_subargs
+    M, K, TW, width = sb.M, sb.num_constant_cols, sb.TW, sb.lookup_width
 
-    chunks = chunk_columns(Ct, geometry.max_allowed_constraint_degree)
-    num_chunks = len(chunks)
+    chunks = list(sb.chunks)
+    num_chunks = sb.num_chunks
     num_partials = num_chunks - 1
-    S = 2 * num_chunks + 2 * R_args + 2 * M
-    B_wit = Ct + W + M
-    B_setup = Ct + K + TW
+    S, B_wit, B_setup = sb.S, sb.B_wit, sb.B_setup
 
-    # quotient degree + selector paths, exactly as generate_setup derives
-    tree, selector_paths = build_selector_tree(assembly.gates)
-    tree_degree, _tree_constants = tree.compute_stats()
-    degree_bound = max(
-        tree_degree, geometry.max_allowed_constraint_degree + 1, 1
-    )
-    derived_q = 1 << (degree_bound - 1).bit_length()
-    Q = config.quotient_degree or derived_q
-    B_q = 2 * Q
-    B_all = B_wit + B_setup + S + B_q
+    # selector paths are structure, not shape — still derived here, exactly
+    # as generate_setup derives them (shape_key resolves Q the same way)
+    _tree, selector_paths = build_selector_tree(assembly.gates)
+    Q = sb.quotient_degree
+    B_q = sb.B_q
+    B_all = sb.B_all
     non_residues = non_residues_for_copy_permutation(Ct)
 
     total_cols = B_all
@@ -451,9 +440,14 @@ def precompile(
     first dispatch like before. With `lower_only`, skips the backend
     compile — used by tier-1 tests to validate the enumeration on CPU,
     and still exercises every trace path."""
+    from .shape_key import bucket_key
+
     if ledger is None:
         ledger = current_compile_ledger() or CompileLedger()
-    with _span("precompile_enumerate"):
+    # every ledger entry of this sweep carries the shape-bucket key —
+    # the SAME key the service admission queue groups requests by
+    shape = bucket_key(assembly, config)
+    with _span("precompile_enumerate", shape=shape):
         specs = enumerate_kernels(assembly, config, mesh_shape=mesh_shape)
     _metrics.count("precompile.kernels", len(specs))
 
@@ -465,7 +459,8 @@ def precompile(
                 low = spec.fn.lower(*spec.args)
             except Exception as e:  # noqa: BLE001 - record and continue
                 ledger.record(
-                    spec.name, time.perf_counter() - t0, 0.0, error=repr(e)
+                    spec.name, time.perf_counter() - t0, 0.0, error=repr(e),
+                    shape_key=shape,
                 )
                 _metrics.count("precompile.lower_errors")
                 continue
@@ -473,7 +468,8 @@ def precompile(
 
     if lower_only:
         for spec, trace_s, _low in lowered:
-            ledger.record(spec.name, trace_s, 0.0, cache_hit=None)
+            ledger.record(spec.name, trace_s, 0.0, cache_hit=None,
+                          shape_key=shape)
         return ledger
 
     def _compile_one(item):
@@ -483,7 +479,8 @@ def precompile(
             low.compile()
         except Exception as e:  # noqa: BLE001
             ledger.record(
-                spec.name, trace_s, time.perf_counter() - t0, error=repr(e)
+                spec.name, trace_s, time.perf_counter() - t0, error=repr(e),
+                shape_key=shape,
             )
             _metrics.count("precompile.compile_errors")
             return
@@ -491,7 +488,8 @@ def precompile(
         # sub-100ms "compiles" are persistent-cache loads in practice —
         # a heuristic, but the ledger's monitoring counters carry the
         # authoritative process-wide hit/miss totals
-        ledger.record(spec.name, trace_s, dt, cache_hit=dt < 0.1)
+        ledger.record(spec.name, trace_s, dt, cache_hit=dt < 0.1,
+                      shape_key=shape)
 
     def _weight(item):
         # schedule the biggest modules first: with K workers and a handful

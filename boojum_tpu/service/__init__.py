@@ -1,0 +1,41 @@
+"""Proving-as-a-service: the multi-tenant batch scheduler over the mesh.
+
+Public surface:
+
+- `ProvingService` / `ServiceConfig` / `ProveRequest` — the service
+  itself (service.py): shape-bucketed admission, device-resident cache
+  manager, shard-vs-proof-parallel placement, per-request SLO records.
+- `AdmissionQueue` / `QueueFullError` / `LANES` — the bounded priority
+  queue (queue.py).
+- `DeviceCacheManager` — byte-capped LRU over pinned device state
+  (cache.py).
+- `choose_placement` / `Placement` / `SHARD_PARALLEL` / `PROOF_PARALLEL`
+  — the scheduler (scheduler.py).
+
+Driver CLI: `scripts/prove_service.py`; bench integration:
+`bench.py --service`.
+"""
+
+from .cache import DeviceCacheManager
+from .queue import LANES, AdmissionQueue, QueueFullError
+from .scheduler import (
+    PROOF_PARALLEL,
+    SHARD_PARALLEL,
+    Placement,
+    choose_placement,
+)
+from .service import ProveRequest, ProvingService, ServiceConfig
+
+__all__ = [
+    "AdmissionQueue",
+    "DeviceCacheManager",
+    "LANES",
+    "Placement",
+    "PROOF_PARALLEL",
+    "ProveRequest",
+    "ProvingService",
+    "QueueFullError",
+    "SHARD_PARALLEL",
+    "ServiceConfig",
+    "choose_placement",
+]

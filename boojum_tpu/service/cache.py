@@ -1,0 +1,210 @@
+"""Device-resident cache manager: pin amortizable state across requests.
+
+ICICLE's deployment model (PAPERS.md) keeps setup/twiddle/table state
+device-resident across proof requests instead of rebuilding per proof;
+this manager is that layer for the proving service. Two classes of
+state, treated differently because they free differently:
+
+- **Per-setup residency** (the big, evictable items): the sigma column
+  stack, grand-product x powers, non-residues and lookup tables that
+  `prover._dev_cached` parks on the setup/assembly objects (~8·Ct·n
+  bytes for sigma alone — ~0.5 GB at 2^20). The manager holds the only
+  long-lived references, measures ACTUAL resident bytes from the
+  `_dev_cache` dicts after each request, and evicts least-recently-used
+  entries (clearing those dicts, so the buffers free and the next
+  request re-uploads on miss) when the byte cap is exceeded.
+- **Per-geometry tables** (small, global, shared): twiddle/domain
+  contexts (`ntt.warm_domain_caches`), brev-domain constants and FRI
+  fold/1-over-x tables live in module `lru_cache`s keyed by
+  (log_n, rate) — already shared by every same-shape request and not
+  individually evictable. The manager WARMS them at admission (so the
+  first request of a bucket pays the build outside a transcript
+  barrier) and reports their estimated footprint, but the byte cap
+  applies only to the evictable class.
+
+Hits/misses/evictions are charged through
+`utils.metrics.count_service_cache` (`service.cache.*`), pinned bytes to
+the `service.cache.pinned_bytes` gauge — the `prove_report.py --check`
+gate validates the schema.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ..utils import metrics as _metrics
+from ..utils.profiling import log as _log
+
+
+def _dev_cache_bytes(obj) -> int:
+    """Actual resident bytes of one host object's `_dev_cache` (the
+    prover's device-upload cache seam)."""
+    cache = getattr(obj, "_dev_cache", None)
+    if not cache:
+        return 0
+    total = 0
+    for v in cache.values():
+        for leaf in v if isinstance(v, (tuple, list)) else (v,):
+            try:
+                total += int(leaf.size) * leaf.dtype.itemsize
+            except Exception:
+                pass
+    return total
+
+
+@dataclass
+class PinnedEntry:
+    """One pinned (assembly, setup) residency, keyed by the request's
+    shape-bucket key plus the setup's identity (two different circuits
+    can share a shape bucket but never a setup)."""
+
+    bucket_key: str
+    assembly: object
+    setup: object
+    bytes: int = 0
+    hits: int = 0
+    pinned_ts: float = field(default_factory=time.perf_counter)
+
+    def measure(self) -> int:
+        self.bytes = _dev_cache_bytes(self.setup) + _dev_cache_bytes(
+            self.assembly
+        )
+        return self.bytes
+
+    def release(self):
+        """Drop the device residency: clearing the `_dev_cache` dicts
+        releases the manager's references so the buffers free; the next
+        prove of this setup transparently re-uploads (a cache MISS, not
+        an error)."""
+        for obj in (self.setup, self.assembly):
+            cache = getattr(obj, "_dev_cache", None)
+            if cache:
+                cache.clear()
+
+
+class DeviceCacheManager:
+    """Byte-capped LRU over pinned per-setup device residency, plus
+    geometry-table warming. Thread-safe; all accounting no-op-cheap when
+    no metrics registry is installed."""
+
+    def __init__(self, capacity_bytes: int = 2 << 30):
+        self.capacity_bytes = int(capacity_bytes)
+        self._lock = threading.Lock()
+        # key -> PinnedEntry, most-recently-used LAST
+        self._entries: OrderedDict[tuple, PinnedEntry] = OrderedDict()
+        self._warmed_geometries: set[tuple] = set()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+
+    # ---- geometry tables -------------------------------------------------
+    def warm_geometry(self, bucket) -> bool:
+        """Populate the per-geometry transform caches of one shape bucket
+        (twiddles for rates L and Q, domain constants, FRI fold tables) —
+        idempotent and enqueue-only, exactly the set the prover's round-0
+        prefetch touches. Returns True when this call did the warming."""
+        key = (
+            bucket.log_n, bucket.lde_factor, bucket.quotient_degree,
+            bucket.fri_final_degree, bucket.fri_schedule, bucket.lookups,
+        )
+        with self._lock:
+            if key in self._warmed_geometries:
+                return False
+            self._warmed_geometries.add(key)
+        from ..ntt.ntt import warm_domain_caches
+        from ..prover.fri import fold_challenge_tables, fold_schedule
+        from ..prover.prover import _inv_xs_brev
+
+        warm_domain_caches(bucket.log_n, bucket.lde_factor)
+        warm_domain_caches(bucket.log_n, bucket.quotient_degree)
+        if bucket.lookups:
+            _inv_xs_brev(bucket.log_n, bucket.lde_factor)
+        log_full = bucket.log_n + (bucket.lde_factor.bit_length() - 1)
+        num_folds = sum(
+            fold_schedule(
+                bucket.trace_len, bucket.fri_final_degree,
+                list(bucket.fri_schedule) or None,
+            )
+        )
+        fold_challenge_tables(log_full, num_folds)
+        return True
+
+    # ---- per-setup residency --------------------------------------------
+    def pin(self, bucket_key: str, assembly, setup) -> bool:
+        """Mark one (assembly, setup) pair resident for the request being
+        served. Returns True on a HIT (this setup was already pinned —
+        its device buffers survive from an earlier request); False on a
+        MISS (newly pinned; the prove will upload into the residency).
+        Accounting goes to service.cache.hits/misses."""
+        key = (bucket_key, id(setup))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.hits += 1
+                self._entries.move_to_end(key)
+                self.hits += 1
+                hit = True
+            else:
+                self._entries[key] = PinnedEntry(bucket_key, assembly, setup)
+                self.misses += 1
+                hit = False
+        _metrics.count_service_cache("hit" if hit else "miss")
+        return hit
+
+    def after_request(self):
+        """Re-measure resident bytes (uploads happen DURING the prove,
+        so sizes are only known afterwards) and evict LRU entries above
+        the byte cap. Called by the worker loop after each request."""
+        evicted: list[PinnedEntry] = []
+        with self._lock:
+            total = 0
+            for entry in self._entries.values():
+                total += entry.measure()
+            while total > self.capacity_bytes and len(self._entries) > 1:
+                _key, entry = self._entries.popitem(last=False)
+                total -= entry.bytes
+                if entry.bytes > 0:
+                    # a zero-byte entry holds no residency (e.g. its
+                    # request failed before uploading) — dropping it is
+                    # not an EVICTION, and counting one with a zero byte
+                    # gauge would fail the report validator's
+                    # evictions-imply-evicted-bytes consistency check
+                    self.evictions += 1
+                    self.evicted_bytes += entry.bytes
+                evicted.append(entry)
+            pinned = total
+        for entry in evicted:
+            # released OUTSIDE the lock: freeing device buffers can call
+            # into the backend
+            if entry.bytes > 0:
+                _metrics.count_service_cache("evict", entry.bytes)
+                _log(
+                    f"service cache: evicted {entry.bucket_key} "
+                    f"({entry.bytes / 2**20:.1f} MiB, {entry.hits} hits)"
+                )
+            entry.release()
+        _metrics.gauge_service("cache.pinned_bytes", pinned)
+        return pinned
+
+    # ---- introspection ---------------------------------------------------
+    def pinned_bytes(self) -> int:
+        with self._lock:
+            return sum(e.bytes for e in self._entries.values())
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "pinned_bytes": sum(
+                    e.bytes for e in self._entries.values()
+                ),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "evicted_bytes": self.evicted_bytes,
+                "warmed_geometries": len(self._warmed_geometries),
+            }

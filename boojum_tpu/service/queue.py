@@ -1,0 +1,141 @@
+"""Shape-bucketed admission queue with priority lanes and backpressure.
+
+Requests are grouped by the SAME shape-bucket key the precompile pass
+and the compile ledger use (`prover/shape_key.py`) — same key means same
+kernel library, shared domain/twiddle caches and a setup that can stay
+device-resident across the batch. The scheduler reads bucket occupancy
+to pick a placement (one big shard-parallel proof vs. packing
+proof-parallel ones), so the queue's job is to keep same-shape work
+adjacent without letting heavy lanes starve interactive ones.
+
+Lanes are strict-priority: "interactive" drains before "batch" drains
+before "bulk" (a recursive 2^20 aggregation job belongs in bulk; a
+wallet-facing proof in interactive). Within a lane, order is FIFO —
+except that `pop_batch` gathers FOLLOWERS of the head's shape bucket
+from the SAME lane, so a drain amortizes warmed state across every
+queued same-shape request without reordering across buckets more than
+one batch deep.
+
+Admission is bounded: above `capacity` the queue REJECTS
+(`QueueFullError`) instead of buffering unboundedly — the caller sheds
+load or retries, and the rejection is charged to the
+`service.queue.rejects` counter. This is deliberate backpressure, not a
+failure mode: an unbounded queue turns overload into latency for every
+tenant, a bounded one turns it into an explicit signal for the few.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+
+from ..utils import metrics as _metrics
+
+# strict-priority lane order (drain left to right)
+LANES = ("interactive", "batch", "bulk")
+
+
+class QueueFullError(RuntimeError):
+    """Admission rejected: the bounded queue is at capacity (the
+    backpressure signal — retry later or shed load)."""
+
+
+class AdmissionQueue:
+    def __init__(self, capacity: int = 64):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        # lane -> OrderedDict[bucket_key -> list[request]] preserves both
+        # FIFO order across buckets (insertion order of the OrderedDict)
+        # and within a bucket (list append order)
+        self._lanes: dict[str, OrderedDict] = {
+            lane: OrderedDict() for lane in LANES
+        }
+        self._depth = 0
+        self.rejects = 0
+        self.admitted = 0
+
+    # ---- admission -------------------------------------------------------
+    def submit(self, request) -> None:
+        """Admit one request (request.priority names the lane,
+        request.bucket_key the shape bucket). Raises QueueFullError at
+        capacity."""
+        lane = request.priority
+        if lane not in self._lanes:
+            raise ValueError(
+                f"unknown priority lane {lane!r}: use one of {LANES}"
+            )
+        with self._lock:
+            if self._depth >= self.capacity:
+                self.rejects += 1
+                _metrics.count("service.queue.rejects")
+                raise QueueFullError(
+                    f"admission queue at capacity ({self.capacity}); "
+                    f"{self.rejects} rejects so far"
+                )
+            request.admit_ts = time.perf_counter()
+            buckets = self._lanes[lane]
+            if request.bucket_key not in buckets:
+                buckets[request.bucket_key] = []
+            buckets[request.bucket_key].append(request)
+            self._depth += 1
+            self.admitted += 1
+            _metrics.gauge_service("queue.depth", self._depth)
+            self._not_empty.notify()
+
+    # ---- draining --------------------------------------------------------
+    def pop_batch(self, limit: int | None = None) -> list:
+        """Remove and return the head request plus up to `limit - 1`
+        same-bucket followers from the head's lane (highest-priority
+        nonempty lane first). Empty list when the queue is empty."""
+        with self._lock:
+            for lane in LANES:
+                buckets = self._lanes[lane]
+                if not buckets:
+                    continue
+                key, reqs = next(iter(buckets.items()))
+                take = len(reqs) if limit is None else min(limit, len(reqs))
+                batch = reqs[:take]
+                del reqs[:take]
+                if not reqs:
+                    del buckets[key]
+                self._depth -= len(batch)
+                _metrics.gauge_service("queue.depth", self._depth)
+                return batch
+            return []
+
+    def wait_nonempty(self, timeout: float | None = None) -> bool:
+        """Block until at least one request is queued (worker-loop idle
+        wait); True when work is available."""
+        with self._lock:
+            if self._depth:
+                return True
+            return self._not_empty.wait_for(
+                lambda: self._depth > 0, timeout=timeout
+            )
+
+    # ---- introspection (the scheduler's inputs) --------------------------
+    def depth(self) -> int:
+        with self._lock:
+            return self._depth
+
+    def occupancy(self, bucket_key: str) -> int:
+        """How many queued requests share this shape bucket (across all
+        lanes) — the scheduler's proof-parallel packing signal."""
+        with self._lock:
+            return sum(
+                len(buckets.get(bucket_key, ()))
+                for buckets in self._lanes.values()
+            )
+
+    def bucket_depths(self) -> dict[str, int]:
+        """bucket_key -> queued request count, across lanes."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for buckets in self._lanes.values():
+                for key, reqs in buckets.items():
+                    out[key] = out.get(key, 0) + len(reqs)
+            return out

@@ -1,0 +1,171 @@
+"""Placement scheduler: shard-parallel vs proof-parallel, per request.
+
+DIZK's conclusion (PAPERS.md) is that distributed proving throughput is
+a scheduling problem as much as a kernel problem: the same mesh can run
+ONE proof spread across every chip (the PR 5 `shard_sweep` path —
+minimum latency for a big trace, but collectives + per-chip variants for
+work that may not fill the mesh) or MANY independent proofs packed one
+per chip / sub-mesh (maximum throughput for small traces — zero
+interconnect traffic, each chip runs the meshless kernel library).
+
+The decision inputs are exactly what the admission queue exposes:
+
+- **trace size**: a trace at/above `shard_threshold_rows` (default 2^17;
+  `BOOJUM_TPU_SERVICE_SHARD_ROWS`) wants the whole mesh — a 2^20
+  recursive job on one chip would monopolize it for the wall-clock the
+  mesh exists to divide, and may not even fit one chip's HBM.
+- **bucket occupancy**: several queued same-shape small jobs pack
+  proof-parallel (they share one warmed meshless kernel library); even a
+  LONE small job stays meshless — mesh collectives cost more than they
+  parallelize at small n, and dispatching the `_sm` kernel variants
+  would compile a second library for no win.
+
+`warm_for_placement` then warms exactly the kernel-library variant the
+chosen placement dispatches (`precompile.enumerate_kernels(mesh_shape=)`
+enumerates only the dispatched set), so admission-time compile work
+never builds variants the prove won't run.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+from ..utils.profiling import current_compile_ledger, log as _log
+from ..utils.spans import span as _span
+
+SHARD_PARALLEL = "shard_parallel"
+PROOF_PARALLEL = "proof_parallel"
+PLACEMENTS = (SHARD_PARALLEL, PROOF_PARALLEL)
+
+DEFAULT_SHARD_THRESHOLD_ROWS = 1 << 17
+
+
+@dataclass
+class Placement:
+    """One scheduling decision: how a request runs on the mesh."""
+
+    kind: str                  # SHARD_PARALLEL | PROOF_PARALLEL
+    mesh: object | None        # the Mesh a shard-parallel prove spans
+    pack: int = 1              # proof-parallel: how many requests the
+    #                            drain batch packs concurrently (1 = serial)
+    total_devices: int = 1     # the service's chip count (occupancy
+    #                            denominator — proof-parallel placements
+    #                            carry mesh=None, so it rides here)
+    reason: str = ""
+
+    @property
+    def occupancy(self) -> float:
+        """Fraction of the service's chips this placement lights up per
+        proof — the per-request SLO record's occupancy field."""
+        if self.kind == SHARD_PARALLEL:
+            return 1.0
+        return 1.0 / max(self.total_devices, 1)
+
+
+def _mesh_devices(mesh) -> int:
+    if mesh is None:
+        return 1
+    try:
+        return int(mesh.devices.size)
+    except Exception:
+        return 1
+
+
+def shard_threshold_rows() -> int:
+    """BOOJUM_TPU_SERVICE_SHARD_ROWS: trace row count at/above which a
+    request runs shard-parallel across the whole mesh (default 2^17)."""
+    v = os.environ.get("BOOJUM_TPU_SERVICE_SHARD_ROWS", "").strip()
+    if not v:
+        return DEFAULT_SHARD_THRESHOLD_ROWS
+    rows = int(v)
+    if rows < 1:
+        raise ValueError(
+            f"BOOJUM_TPU_SERVICE_SHARD_ROWS={v!r}: must be >= 1"
+        )
+    return rows
+
+
+def choose_placement(
+    bucket,
+    occupancy: int,
+    mesh,
+    max_inflight: int = 1,
+    threshold_rows: int | None = None,
+) -> Placement:
+    """Pick the placement for one request (or drain batch) of `bucket`.
+
+    `occupancy` is the bucket's queued-request count (admission queue),
+    `mesh` the service's mesh (None on a single chip — everything is
+    proof-parallel then)."""
+    if threshold_rows is None:
+        threshold_rows = shard_threshold_rows()
+    n_dev = _mesh_devices(mesh)
+    if mesh is not None and bucket.trace_len >= threshold_rows:
+        return Placement(
+            SHARD_PARALLEL, mesh, total_devices=n_dev,
+            reason=(
+                f"trace 2^{bucket.log_n} >= shard threshold "
+                f"{threshold_rows} rows: one proof across {n_dev} chips"
+            ),
+        )
+    pack = max(1, min(occupancy, max_inflight, n_dev))
+    return Placement(
+        PROOF_PARALLEL, None, pack=pack, total_devices=n_dev,
+        reason=(
+            f"trace 2^{bucket.log_n} below shard threshold; "
+            f"bucket occupancy {occupancy}: meshless proofs"
+            + (f" packed {pack}-wide" if pack > 1 else "")
+        ),
+    )
+
+
+class VariantWarmer:
+    """Warm exactly the kernel-library variant a placement dispatches.
+
+    One warm per (bucket key, placement kind) per service lifetime:
+    `precompile.enumerate_kernels(mesh_shape=)` derives the `_sm` set for
+    shard-parallel placements and the meshless set otherwise, and
+    `precompile()` pushes it through the persistent cache on a thread
+    pool. `mode` = "full" (lower + backend compile), "lower" (trace-only
+    — the CPU-test posture: validates enumeration, skips the compile
+    bill), or "off"."""
+
+    def __init__(self, mode: str = "full", max_workers: int = 8):
+        if mode not in ("full", "lower", "off"):
+            raise ValueError(
+                f"precompile mode {mode!r}: use full | lower | off"
+            )
+        self.mode = mode
+        self.max_workers = max_workers
+        self._warmed: set[tuple] = set()
+
+    def warm(self, bucket, assembly, config, placement: Placement) -> bool:
+        if self.mode == "off":
+            return False
+        key = (bucket.key, placement.kind)
+        if key in self._warmed:
+            return False
+        self._warmed.add(key)
+        from ..prover.precompile import precompile
+
+        mesh_shape = (
+            placement.mesh if placement.kind == SHARD_PARALLEL else None
+        )
+        t0 = time.perf_counter()
+        with _span(
+            "service_warm_variant", shape=bucket.key, placement=placement.kind
+        ):
+            precompile(
+                assembly, config,
+                max_workers=self.max_workers,
+                ledger=current_compile_ledger(),
+                lower_only=self.mode == "lower",
+                mesh_shape=mesh_shape,
+            )
+        _log(
+            f"service: warmed {placement.kind} variant of {bucket.key} "
+            f"in {time.perf_counter() - t0:.1f}s ({self.mode})"
+        )
+        return True

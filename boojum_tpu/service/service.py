@@ -1,0 +1,429 @@
+"""ProvingService: the multi-tenant batch-proving layer over the mesh.
+
+The repo's perf work (PRs 1-5) made one `prove()` fast; this service
+makes MANY of them cheap by owning the mesh and amortizing everything
+amortizable across requests:
+
+- admission through the shape-bucketed, priority-laned, bounded queue
+  (`service/queue.py` — backpressure via QueueFullError);
+- device-resident caches pinned across requests with byte-capped LRU
+  eviction (`service/cache.py`);
+- per-batch placement between shard-parallel (one proof across the
+  whole mesh, the PR 5 shard_map path) and proof-parallel (independent
+  meshless proofs, packable one-per-chip), with the kernel-library
+  variant of the CHOSEN placement warmed through the precompile pass
+  (`service/scheduler.py`);
+- per-request SLO records — queue latency, prove wall, placement,
+  occupancy, proofs/sec, plus the full flight-recorder axis (spans,
+  `ici.*` bytes, digest checkpoints) — appended as ProveReport JSONL
+  lines that `scripts/prove_report.py --check/--slo` validate.
+
+Proof bytes and digest-checkpoint streams are bit-identical to direct
+`prove()` calls regardless of placement: the service only picks WHICH
+validated execution mode runs (meshless vs. the PR 5 mesh path, both
+pinned bit-identical by tests/test_mesh_parity.py) and never touches
+the transcript.
+
+Concurrency contract: requests are served one batch at a time by ONE
+worker loop (the mesh is one resource). Proof-parallel packing runs up
+to `max_inflight` same-bucket requests concurrently on distinct chips
+— but only when flight recording is OFF, because the recorder's
+span/metrics/checkpoint collectors are process-global and interleaved
+recording would corrupt the per-request checkpoint streams; with
+recording on, packing degrades to sequential (the SLO record notes
+`packed: 1`). Cross-host proof-parallelism composes through
+`parallel.multihost.distribute_proofs` (see scripts/multihost_worker).
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..prover.shape_key import shape_bucket
+from ..utils import metrics as _metrics
+from ..utils import report as _report
+from ..utils.profiling import log as _log
+from ..utils.spans import span as _span
+from .cache import DeviceCacheManager
+from .queue import LANES, AdmissionQueue, QueueFullError  # noqa: F401
+from .scheduler import (
+    PROOF_PARALLEL,
+    SHARD_PARALLEL,
+    Placement,
+    VariantWarmer,
+    choose_placement,
+)
+
+REQUEST_SCHEMA = 1
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return default
+    try:
+        return int(float(v))
+    except ValueError:
+        raise ValueError(f"{name}={v!r}: not a number") from None
+
+
+@dataclass
+class ServiceConfig:
+    """Knobs of one ProvingService (env: BOOJUM_TPU_SERVICE_*)."""
+
+    queue_capacity: int = 64       # BOOJUM_TPU_SERVICE_QUEUE_CAP
+    cache_bytes: int = 2 << 30     # BOOJUM_TPU_SERVICE_CACHE_BYTES
+    max_inflight: int = 1          # BOOJUM_TPU_SERVICE_MAX_INFLIGHT
+    # kernel-library warm mode per (bucket, placement):
+    #   full = lower + backend compile (production), lower = trace only
+    #   (CPU-test posture), off = compile at first dispatch
+    precompile: str = "full"       # BOOJUM_TPU_SERVICE_PRECOMPILE
+    # shard threshold rides BOOJUM_TPU_SERVICE_SHARD_ROWS (scheduler.py)
+    shard_threshold_rows: int | None = None
+    report_path: str | None = None  # default: BOOJUM_TPU_REPORT
+    mesh: object | str | None = "auto"  # "auto" | Mesh | None (meshless)
+
+    @classmethod
+    def from_env(cls) -> "ServiceConfig":
+        return cls(
+            queue_capacity=_env_int("BOOJUM_TPU_SERVICE_QUEUE_CAP", 64),
+            cache_bytes=_env_int(
+                "BOOJUM_TPU_SERVICE_CACHE_BYTES", 2 << 30
+            ),
+            max_inflight=_env_int("BOOJUM_TPU_SERVICE_MAX_INFLIGHT", 1),
+            precompile=os.environ.get(
+                "BOOJUM_TPU_SERVICE_PRECOMPILE", ""
+            ).strip().lower() or "full",
+        )
+
+
+@dataclass
+class ProveRequest:
+    """One admitted proving job. `result()` blocks for the proof; the
+    `slo` dict mirrors the request record the report line carries."""
+
+    assembly: object
+    setup: object
+    config: object
+    id: str
+    priority: str = "batch"
+    tenant: str = "default"
+    bucket: object = None          # ShapeBucket, stamped at submit
+    bucket_key: str = ""
+    submit_ts: float = 0.0
+    admit_ts: float = 0.0
+    proof: object = None
+    error: BaseException | None = None
+    slo: dict = field(default_factory=dict)
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: float | None = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError(f"request {self.id} still queued/running")
+        if self.error is not None:
+            raise self.error
+        return self.proof
+
+
+class ProvingService:
+    def __init__(self, config: ServiceConfig | None = None):
+        import jax
+
+        self.config = config or ServiceConfig.from_env()
+        self.queue = AdmissionQueue(self.config.queue_capacity)
+        self.cache = DeviceCacheManager(self.config.cache_bytes)
+        self.warmer = VariantWarmer(self.config.precompile)
+        self.devices = list(jax.devices())
+        mesh = self.config.mesh
+        if mesh == "auto":
+            # one process, >1 chip: own the whole mesh. Multi-process
+            # deployments keep per-host services meshless and scale
+            # proof-parallel across hosts (multihost.distribute_proofs);
+            # a DCN-spanning shard-parallel mesh is opt-in via an
+            # explicit Mesh (e.g. multihost.hybrid_mesh()).
+            multi = False
+            try:
+                multi = jax.process_count() > 1
+            except Exception:
+                pass
+            if len(self.devices) > 1 and not multi:
+                from ..parallel.sharding import make_mesh
+
+                mesh = make_mesh(self.devices)
+            else:
+                mesh = None
+        self.mesh = mesh
+        self.report_path = (
+            self.config.report_path
+            if self.config.report_path is not None
+            else _report.default_report_path()
+        )
+        self._ids = itertools.count(1)
+        self._serve_lock = threading.Lock()
+        # packed proof-parallel mode mutates these from pool threads
+        self._stats_lock = threading.Lock()
+        self.stats = {
+            "served": 0,
+            "failed": 0,
+            "batches": 0,
+            "placements": {SHARD_PARALLEL: 0, PROOF_PARALLEL: 0},
+            "prove_wall_s": 0.0,
+            "queue_latency_s": 0.0,
+        }
+
+    # ---- admission -------------------------------------------------------
+    def submit(
+        self,
+        assembly,
+        setup,
+        config,
+        priority: str = "batch",
+        tenant: str = "default",
+        request_id: str | None = None,
+    ) -> ProveRequest:
+        """Admit one job (raises QueueFullError at the queue bound —
+        the caller's backpressure signal). Shape bucketing happens here,
+        with the SAME key the precompile pass and compile ledger use."""
+        req = ProveRequest(
+            assembly=assembly,
+            setup=setup,
+            config=config,
+            id=request_id or f"req-{next(self._ids):04d}",
+            priority=priority,
+            tenant=tenant,
+        )
+        req.bucket = shape_bucket(assembly, config)
+        req.bucket_key = req.bucket.key
+        req.submit_ts = time.perf_counter()
+        self.queue.submit(req)  # stamps admit_ts
+        return req
+
+    # ---- serving ---------------------------------------------------------
+    def process_once(self) -> int:
+        """Drain ONE same-bucket batch: schedule, warm, prove, record.
+        Returns the number of requests served (0 = queue empty)."""
+        with self._serve_lock:
+            batch = self.queue.pop_batch(
+                limit=max(self.config.max_inflight, 1)
+                if self.config.max_inflight > 1
+                else None
+            )
+            if not batch:
+                return 0
+            return self._serve_batch(batch)
+
+    def run_worker(
+        self, stop: threading.Event | None = None, idle_wait_s: float = 0.0
+    ) -> dict:
+        """The worker loop: drain the queue until empty (idle_wait_s=0)
+        or until `stop` is set (a serving daemon passes idle_wait_s > 0
+        to block for new work). Returns the service stats summary."""
+        t0 = time.perf_counter()
+        while stop is None or not stop.is_set():
+            served = self.process_once()
+            if served:
+                continue
+            if idle_wait_s <= 0:
+                break
+            self.queue.wait_nonempty(timeout=idle_wait_s)
+            if (
+                not self.queue.depth()
+                and stop is not None
+                and stop.is_set()
+            ):
+                break
+        return self.summary(wall_s=time.perf_counter() - t0)
+
+    # ---- internals -------------------------------------------------------
+    def _serve_batch(self, batch: list) -> int:
+        bucket = batch[0].bucket
+        occupancy = len(batch) + self.queue.occupancy(bucket.key)
+        placement = choose_placement(
+            bucket,
+            occupancy,
+            self.mesh,
+            max_inflight=self.config.max_inflight,
+            threshold_rows=self.config.shard_threshold_rows,
+        )
+        _log(
+            f"service: batch of {len(batch)} x {bucket.key} -> "
+            f"{placement.kind} ({placement.reason})"
+        )
+        # warm OUTSIDE the per-request recording window: compile bill is
+        # service state, not a request's SLO (the ledger keeps per-shape
+        # attribution), and the geometry tables are bucket-level
+        self.warmer.warm(bucket, batch[0].assembly, batch[0].config,
+                         placement)
+        self.cache.warm_geometry(bucket)
+
+        recording = bool(self.report_path) or bool(
+            os.environ.get("BOOJUM_TPU_REPORT")
+        )
+        pack = placement.pack if placement.kind == PROOF_PARALLEL else 1
+        batch_t0 = time.perf_counter()
+        if pack > 1 and len(batch) > 1 and not recording:
+            served = self._serve_packed(batch, placement)
+        else:
+            if pack > 1:
+                # recording ON: the flight recorder's collectors are
+                # process-global, so packing degrades to sequential to
+                # keep per-request checkpoint streams uncorrupted
+                placement = Placement(
+                    placement.kind, placement.mesh, pack=1,
+                    total_devices=placement.total_devices,
+                    reason=placement.reason + " (sequential: recording on)",
+                )
+            served = 0
+            for req in batch:
+                served += self._serve_one(req, placement)
+        batch_wall = time.perf_counter() - batch_t0
+        with self._stats_lock:
+            self.stats["batches"] += 1
+            self.stats["placements"][placement.kind] += len(batch)
+        if served and batch_wall > 0:
+            _metrics.gauge_service(
+                "batch_proofs_per_sec", served / batch_wall
+            )
+        self.cache.after_request()
+        return served
+
+    def _serve_one(self, req: ProveRequest, placement: Placement) -> int:
+        """Serve one request sequentially, with full flight recording
+        when a report path is configured."""
+        if not self.report_path:
+            return self._run_request(req, placement)
+        with _report.flight_recording(label=f"service:{req.id}") as rec:
+            try:
+                ok = self._run_request(req, placement)
+            finally:
+                # the request record rides the ProveReport line even
+                # when the prove raised — a failed request's partial
+                # spans + SLO fields are the post-mortem
+                try:
+                    _report.append_jsonl(
+                        self.report_path,
+                        _report.build_report(
+                            rec, extra={"request": dict(req.slo)}
+                        ),
+                    )
+                except Exception as e:  # noqa: BLE001 — recording must
+                    # never turn a served proof into a failure
+                    _log(f"service: report write failed: {e!r}")
+        return ok
+
+    def _serve_packed(self, batch: list, placement: Placement) -> int:
+        """Proof-parallel packing: same-bucket requests run concurrently,
+        each pinned to its own chip via jax.default_device. Only reached
+        with recording off (see class docstring), so no report lines are
+        written; each request's `slo` dict still carries its SLO fields."""
+        import jax
+
+        devices = (
+            list(self.mesh.devices.ravel()) if self.mesh is not None
+            else self.devices
+        )
+        width = min(placement.pack, len(batch), len(devices))
+
+        def run(i_req):
+            i, req = i_req
+            with jax.default_device(devices[i % width]):
+                return self._run_request(req, placement, packed=width)
+
+        with ThreadPoolExecutor(max_workers=width) as pool:
+            served = sum(pool.map(run, enumerate(batch)))
+        return served
+
+    def _run_request(
+        self, req: ProveRequest, placement: Placement, packed: int = 1
+    ) -> int:
+        from ..prover.prover import prove
+
+        serve_ts = time.perf_counter()
+        queue_latency = serve_ts - req.submit_ts
+        hit = self.cache.pin(req.bucket_key, req.assembly, req.setup)
+        _metrics.gauge_service("occupancy", placement.occupancy)
+        req.slo = {
+            "schema": REQUEST_SCHEMA,
+            "id": req.id,
+            "tenant": req.tenant,
+            "priority": req.priority,
+            "bucket": req.bucket_key,
+            "placement": placement.kind,
+            "packed": packed,
+            "occupancy": round(placement.occupancy, 4),
+            "queue_latency_s": round(queue_latency, 6),
+            "cache_hit": hit,
+        }
+        t0 = time.perf_counter()
+        try:
+            with _span(
+                "service_request", request=req.id, placement=placement.kind
+            ):
+                proof = prove(
+                    req.assembly, req.setup, req.config,
+                    mesh=placement.mesh,
+                )
+                wall = time.perf_counter() - t0
+        except BaseException as e:
+            req.error = e
+            req.slo["error"] = repr(e)
+            req.slo["prove_wall_s"] = round(
+                time.perf_counter() - t0, 6
+            )
+            with self._stats_lock:
+                self.stats["failed"] += 1
+                self.stats["queue_latency_s"] += queue_latency
+            req._done.set()
+            if isinstance(e, (KeyboardInterrupt, SystemExit)):
+                raise
+            _log(f"service: request {req.id} failed: {e!r}")
+            return 0
+        req.proof = proof
+        req.slo["prove_wall_s"] = round(wall, 6)
+        req.slo["proofs_per_sec"] = round(packed / wall, 6) if wall else None
+        with self._stats_lock:
+            self.stats["served"] += 1
+            self.stats["prove_wall_s"] += wall
+            self.stats["queue_latency_s"] += queue_latency
+        req._done.set()
+        return 1
+
+    # ---- reporting -------------------------------------------------------
+    def summary(self, wall_s: float | None = None) -> dict:
+        with self._stats_lock:
+            stats = dict(self.stats)
+        served = stats["served"]
+        out = {
+            "served": served,
+            "failed": stats["failed"],
+            "batches": stats["batches"],
+            "placements": dict(stats["placements"]),
+            "queue": {
+                "depth": self.queue.depth(),
+                "admitted": self.queue.admitted,
+                "rejects": self.queue.rejects,
+                "capacity": self.queue.capacity,
+            },
+            "cache": self.cache.stats(),
+            "mean_prove_wall_s": (
+                round(stats["prove_wall_s"] / served, 4)
+                if served else None
+            ),
+            "mean_queue_latency_s": (
+                round(stats["queue_latency_s"] / served, 4)
+                if served else None
+            ),
+        }
+        if wall_s is not None:
+            out["wall_s"] = round(wall_s, 4)
+            if served and wall_s > 0:
+                out["proofs_per_sec"] = round(served / wall_s, 4)
+        return out

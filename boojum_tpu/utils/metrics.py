@@ -180,6 +180,34 @@ def count_ici_all_gather(crossing_bytes: float):
         reg.gauge_add("ici.all_gather_bytes", crossing_bytes)
 
 
+def count_service_cache(event: str, nbytes: int = 0):
+    """Tally one device-resident cache-manager event (service/cache.py).
+    `event` is "hit" | "miss" | "evict"; the seam owns the `service.*`
+    gauge names so the cache manager, the report validator and the SLO
+    summary can never disagree on them:
+      service.cache.hits / .misses / .evictions   (counters)
+      service.cache.evicted_bytes                 (gauge, evictions only)
+    """
+    reg = _REGISTRY
+    if reg is None:
+        return
+    if event == "hit":
+        reg.count("service.cache.hits")
+    elif event == "miss":
+        reg.count("service.cache.misses")
+    elif event == "evict":
+        reg.count("service.cache.evictions")
+        reg.gauge_add("service.cache.evicted_bytes", float(nbytes))
+
+
+def gauge_service(name: str, v: float):
+    """Set a `service.<name>` gauge (queue depth, pinned bytes, occupancy
+    — the proving service's per-request SLO axis)."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.gauge_set(f"service.{name}", float(v))
+
+
 def stage_boundary(label: str):
     reg = _REGISTRY
     if reg is not None:

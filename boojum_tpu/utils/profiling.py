@@ -179,9 +179,15 @@ class CompileLedger:
         # counted twice
         self.suppress_log_capture = False
 
-    # -- explicit source (precompile.py) ----------------------------------
+    # -- explicit source (precompile.py / service warm-up) -----------------
     def record(self, name: str, trace_s: float, compile_s: float,
-               cache_hit: bool | None = None, error: str | None = None):
+               cache_hit: bool | None = None, error: str | None = None,
+               shape_key: str | None = None):
+        """`shape_key` is the canonical shape-bucket key of the
+        (assembly, config) pair this kernel belongs to
+        (prover/shape_key.py) — the SAME key the service admission queue
+        buckets on, so a compile-bill regression is attributable to the
+        bucket that paid it."""
         with self._lock:
             entry = {
                 "name": name,
@@ -190,6 +196,8 @@ class CompileLedger:
                 "cache_hit": cache_hit,
                 "ts": round(time.monotonic() - self._t0, 4),
             }
+            if shape_key is not None:
+                entry["shape"] = shape_key
             if error is not None:
                 entry["error"] = error
             self.entries.append(entry)
@@ -250,8 +258,10 @@ class CompileLedger:
         worst = max(
             entries + dispatch, key=lambda e: e["compile_s"], default=None
         )
+        shapes = sorted({e["shape"] for e in entries if e.get("shape")})
         return {
             "num_kernels": len(entries),
+            "shapes": shapes,
             "precompile_total_s": round(compile_total, 3),
             "num_dispatch_compiles": len(dispatch),
             "dispatch_compile_total_s": round(

@@ -37,6 +37,14 @@ REPORT_SCHEMA = 1
 # never decrease along the stream
 ROUND_ORDER = (0, 1, 2, 3, 4, 5)
 
+# the proving service's placements (service/scheduler.py) — a request
+# record carrying anything else fails validation
+REQUEST_PLACEMENTS = ("shard_parallel", "proof_parallel")
+# fields every per-request SLO record must carry (service/service.py);
+# prove_wall_s is additionally required unless the record carries an
+# error (a failed request may die before its wall is measured)
+REQUEST_REQUIRED = ("id", "bucket", "placement", "queue_latency_s")
+
 
 def _flatten_ints(values):
     out = []
@@ -356,6 +364,59 @@ def validate_report(report: dict) -> list[str]:
                 "ici.all_gathers counted but ici.all_gather_bytes "
                 "gauge is missing/zero"
             )
+        # service.* — the proving service's queue/cache/SLO axis. Every
+        # value must be a finite non-negative number, and evictions must
+        # carry their byte gauge (an eviction that freed zero bytes means
+        # the cache manager's accounting seam was bypassed).
+        for src in (counters, gauges):
+            for k, v in src.items():
+                if not k.startswith("service."):
+                    continue
+                if not isinstance(v, (int, float)) or v != v or v < 0:
+                    problems.append(
+                        f"service metric {k}: invalid value {v!r}"
+                    )
+        if _num(counters.get("service.cache.evictions", 0)) > 0 and not _num(
+            gauges.get("service.cache.evicted_bytes", 0)
+        ) > 0:
+            problems.append(
+                "service.cache.evictions counted but "
+                "service.cache.evicted_bytes gauge is missing/zero"
+            )
+    # per-request SLO record (proving-service lines): the record the
+    # --slo summary and dashboards key on — a request line missing its
+    # queue latency or placement is unusable for SLO accounting and
+    # must fail the --check gate
+    request = report.get("request")
+    if request is not None:
+        if not isinstance(request, dict):
+            problems.append(
+                f"request record malformed: {type(request).__name__}"
+            )
+        else:
+            for k in REQUEST_REQUIRED:
+                if k not in request:
+                    problems.append(f"request record missing {k!r}")
+            ql = request.get("queue_latency_s")
+            if "queue_latency_s" in request and (
+                not isinstance(ql, (int, float)) or ql != ql or ql < 0
+            ):
+                problems.append(
+                    f"request queue_latency_s invalid: {ql!r}"
+                )
+            pl = request.get("placement")
+            if "placement" in request and pl not in REQUEST_PLACEMENTS:
+                problems.append(
+                    f"request placement {pl!r}: want one of "
+                    f"{REQUEST_PLACEMENTS}"
+                )
+            pw = request.get("prove_wall_s")
+            if "error" not in request and (
+                not isinstance(pw, (int, float)) or pw != pw or pw < 0
+            ):
+                problems.append(
+                    f"request prove_wall_s invalid: {pw!r}"
+                )
     return problems
 
 
@@ -444,6 +505,117 @@ def diff_reports(a: dict, b: dict, top: int = 10) -> dict:
     }
 
 
+def _percentile(sorted_vals: list[float], q: float) -> float | None:
+    """Nearest-rank percentile over an already-sorted list (stdlib-only,
+    deterministic; None on empty input)."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def slo_summary(reports: list[dict]) -> dict:
+    """Aggregate the per-request SLO records of a proving-service report
+    artifact: p50/p95 queue latency and prove wall, overall proofs/sec
+    (served count over the submit-to-done span), per-placement and
+    per-priority counts, cache hit rate. Lines without a `request`
+    record (plain proves, bench reps) are ignored."""
+    reqs = [r["request"] for r in reports
+            if isinstance(r.get("request"), dict)]
+    ok = [q for q in reqs if "error" not in q]
+    lat = sorted(
+        q["queue_latency_s"] for q in reqs
+        if isinstance(q.get("queue_latency_s"), (int, float))
+    )
+    walls = sorted(
+        q["prove_wall_s"] for q in ok
+        if isinstance(q.get("prove_wall_s"), (int, float))
+    )
+    # the artifact's serving span: earliest request START (each line is
+    # stamped at completion, so start = unix_ts - the recording wall) to
+    # the last completion — anchoring at the first COMPLETION would drop
+    # that request's entire service time and overstate proofs/sec by
+    # N/(N-1)
+    starts = []
+    ends = []
+    for r in reports:
+        if not isinstance(r.get("request"), dict):
+            continue
+        ts = r.get("unix_ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        wall = r.get("wall_s")
+        starts.append(ts - (wall if isinstance(wall, (int, float)) else 0))
+        ends.append(ts)
+    span_s = (max(ends) - min(starts)) if ends else None
+    total_wall = sum(walls)
+    placements: dict[str, int] = {}
+    priorities: dict[str, int] = {}
+    cache_hits = 0
+    for q in reqs:
+        placements[str(q.get("placement"))] = (
+            placements.get(str(q.get("placement")), 0) + 1
+        )
+        priorities[str(q.get("priority"))] = (
+            priorities.get(str(q.get("priority")), 0) + 1
+        )
+        if q.get("cache_hit"):
+            cache_hits += 1
+
+    def r6(v):
+        return None if v is None else round(v, 6)
+
+    return {
+        "requests": len(reqs),
+        "served": len(ok),
+        "failed": len(reqs) - len(ok),
+        "queue_latency_p50_s": r6(_percentile(lat, 0.50)),
+        "queue_latency_p95_s": r6(_percentile(lat, 0.95)),
+        "prove_wall_p50_s": r6(_percentile(walls, 0.50)),
+        "prove_wall_p95_s": r6(_percentile(walls, 0.95)),
+        # proofs/sec over the serving span when the artifact covers more
+        # than one completion; else the sequential-throughput bound
+        "proofs_per_sec": r6(
+            len(ok) / span_s if span_s and span_s > 0
+            else (len(ok) / total_wall if total_wall > 0 else None)
+        ),
+        "placements": dict(sorted(placements.items())),
+        "priorities": dict(sorted(priorities.items())),
+        "cache_hit_rate": (
+            round(cache_hits / len(reqs), 4) if reqs else None
+        ),
+    }
+
+
+def render_slo(summary: dict) -> str:
+    lines = [
+        f"service SLO: {summary['requests']} requests "
+        f"({summary['served']} served, {summary['failed']} failed)",
+        f"  queue latency p50={summary['queue_latency_p50_s']}s "
+        f"p95={summary['queue_latency_p95_s']}s",
+        f"  prove wall    p50={summary['prove_wall_p50_s']}s "
+        f"p95={summary['prove_wall_p95_s']}s",
+        f"  proofs/sec    {summary['proofs_per_sec']}",
+        f"  cache hit rate {summary['cache_hit_rate']}",
+    ]
+    if summary.get("placements"):
+        lines.append(
+            "  placements    "
+            + ", ".join(
+                f"{k}={v}" for k, v in summary["placements"].items()
+            )
+        )
+    if summary.get("priorities"):
+        lines.append(
+            "  priorities    "
+            + ", ".join(
+                f"{k}={v}" for k, v in summary["priorities"].items()
+            )
+        )
+    return "\n".join(lines)
+
+
 # ---------------------------------------------------------------------------
 # Rendering
 # ---------------------------------------------------------------------------
@@ -512,6 +684,17 @@ def render_report(report: dict, top: int = 10) -> str:
         lines.append(
             f"    [{e.get('seq'):>3}] r{e.get('round')} "
             f"{e.get('label'):<28} {str(e.get('digest'))[:16]}…"
+        )
+    request = report.get("request")
+    if isinstance(request, dict):
+        lines.append(
+            f"  request: {request.get('id')} "
+            f"[{request.get('priority')}/{request.get('tenant')}] "
+            f"bucket={request.get('bucket')} "
+            f"placement={request.get('placement')} "
+            f"queue={request.get('queue_latency_s')}s "
+            f"wall={request.get('prove_wall_s')}s "
+            f"cache_hit={request.get('cache_hit')}"
         )
     ledger = report.get("compile_ledger")
     if ledger:

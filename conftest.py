@@ -19,6 +19,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow' (ROADMAP.md); register the marker so
+    # slow-lane tests don't warn as unknown
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 budget (-m 'not slow')"
+    )
+
 # The axon sitecustomize (PYTHONPATH) registers a remote-TPU PJRT plugin whose
 # backend init blocks even under JAX_PLATFORMS=cpu; deregister it outright so
 # unit tests run on the local 8-device virtual CPU platform.

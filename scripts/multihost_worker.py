@@ -5,8 +5,12 @@ Launched by tests/test_multihost.py with:
 
 Brings up jax.distributed over localhost (CPU backend, 2 virtual devices per
 process), runs the requested DCN mode, and writes its result JSON. Modes:
-  proofs  — distribute_proofs: this process proves its slice of a 3-job
-            queue (proof-parallel; no cross-process collectives)
+  proofs  — proof-parallel through the SERVICE worker loop: this process
+            submits its distribute_proofs slice of a 3-job queue to a
+            local ProvingService (boojum_tpu/service/) and drains it —
+            shape-bucketed admission, device-resident caches, per-request
+            SLO records; no cross-process collectives. The per-host
+            result-line format (proofs dict, ici gauges) is unchanged.
   hybrid  — hybrid_mesh: one proof whose mesh 'col' axis spans both
             processes (GSPMD collectives cross the process boundary)
 """
@@ -98,17 +102,30 @@ def main():
 
     result = {"pid": pid, "process_count": jax.process_count()}
     if mode == "proofs":
-        jobs = [0, 1, 2]
+        # proof-parallel across hosts: distribute_proofs slices the job
+        # queue per process; WITHIN the process the jobs drain through
+        # the service worker loop (meshless placement on a multi-process
+        # runtime — cross-host parallelism needs no device collectives)
+        from boojum_tpu.service import ProvingService, ServiceConfig
 
-        def prove_job(seed):
+        jobs = [0, 1, 2]
+        svc = ProvingService(
+            ServiceConfig(precompile="off", report_path=report_path)
+        )
+        assert svc.mesh is None, "multi-process service must stay meshless"
+
+        def submit_job(seed):
             asm = build_circuit(seed).into_assembly()
             setup = generate_setup(asm, cfg)
-            proof = prove(asm, setup, cfg)
-            assert verify(setup.vk, proof, asm.gates)
-            return proof.to_json()
+            return svc.submit(asm, setup, cfg, request_id=f"job-{seed}")
 
-        mine = distribute_proofs(jobs, prove_job)
-        result["proofs"] = {str(i): p for i, p in mine}
+        mine = distribute_proofs(jobs, submit_job)
+        summary = svc.run_worker()
+        result["service"] = summary
+        assert summary["failed"] == 0, summary
+        for _i, req in mine:
+            assert verify(req.setup.vk, req.result(), req.assembly.gates)
+        result["proofs"] = {str(i): req.result().to_json() for i, req in mine}
     elif mode == "hybrid":
         mesh = hybrid_mesh(col_axis_per_host=2)
         assert mesh.shape["col"] == nprocs * 2, dict(mesh.shape)

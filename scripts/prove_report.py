@@ -20,10 +20,19 @@ Usage:
 
   python scripts/prove_report.py --check <report.jsonl>
       Validate schema + digest-checkpoint monotonicity for EVERY line of
-      the artifact (the cheap post-bench gate). Exits 1 on any problem.
+      the artifact (the cheap post-bench gate) — including the proving
+      service's per-request SLO records (a request line missing its
+      queue latency or placement, or carrying malformed service.*
+      gauges, fails). Exits 1 on any problem.
+
+  python scripts/prove_report.py --slo <report.jsonl>
+      Aggregate the per-request SLO records of a proving-service
+      artifact: p50/p95 queue latency and prove wall, proofs/sec over
+      the serving span, per-placement/priority counts, cache hit rate.
 
 Reports come from BOOJUM_TPU_REPORT=<path> (any prove), bench.py (labeled
-warm-up/rep lines) or scripts/multihost_worker.py (per-host files).
+warm-up/rep lines), scripts/multihost_worker.py (per-host files) or
+scripts/prove_service.py (per-request service lines).
 
 The report library (boojum_tpu/utils/report.py) is loaded standalone —
 by file path, stdlib only — so this CLI never imports boojum_tpu or jax;
@@ -73,6 +82,11 @@ def main(argv=None) -> int:
         help="validate schema + checkpoint monotonicity of every line",
     )
     ap.add_argument(
+        "--slo", metavar="REPORT",
+        help="summarize per-request SLO records (p50/p95 queue latency, "
+             "proofs/sec, placements)",
+    )
+    ap.add_argument(
         "--index", type=int, default=-1,
         help="which JSONL line to use (default: last)",
     )
@@ -105,6 +119,15 @@ def main(argv=None) -> int:
                     f"span coverage {cov * 100:.1f}%"
                 )
         return 1 if bad else 0
+
+    if args.slo:
+        reports = rl.load_reports(args.slo)
+        summary = rl.slo_summary(reports)
+        if not summary["requests"]:
+            print(f"{args.slo}: no per-request SLO records")
+            return 1
+        print(rl.render_slo(summary))
+        return 0
 
     if args.diff:
         a = rl.load_report(args.diff[0], args.index)

@@ -1,0 +1,197 @@
+"""Proving-service worker CLI: drain a batch of requests through
+`boojum_tpu/service/` and emit per-request SLO records.
+
+Usage:
+  python scripts/prove_service.py --demo N [--report out.jsonl]
+      Enqueue N demo jobs (mixed geometries + a priority-lane job),
+      run the worker loop to drain, print the service summary JSON.
+
+  python scripts/prove_service.py --jobs jobs.json [--report out.jsonl]
+      Drive jobs from a spec file: a JSON list of
+        {"circuit": "fma"|"sha256", "log_n": 10 | "bytes": 8192,
+         "priority": "interactive"|"batch"|"bulk", "count": 1,
+         "lde": 2, "queries": 4, "final_degree": 16}
+      entries. Same-shape jobs bucket together in the admission queue.
+
+Environment (see README "Environment flags"):
+  BOOJUM_TPU_SERVICE_QUEUE_CAP    admission-queue bound (default 64)
+  BOOJUM_TPU_SERVICE_CACHE_BYTES  device-cache LRU cap (default 2 GiB)
+  BOOJUM_TPU_SERVICE_SHARD_ROWS   shard-parallel trace threshold (2^17)
+  BOOJUM_TPU_SERVICE_MAX_INFLIGHT proof-parallel pack width (default 1)
+  BOOJUM_TPU_SERVICE_PRECOMPILE   full | lower | off (default full)
+  BOOJUM_TPU_REPORT               default report path (per-request SLO
+                                  JSONL; --report overrides)
+
+Each served request appends one ProveReport JSONL line carrying the
+`request` SLO record (queue latency, placement, occupancy, prove wall,
+proofs/sec, cache hit) on top of the flight recorder's span/metrics/
+checkpoint axes. Validate with `scripts/prove_report.py --check`,
+summarize with `--slo`.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_fma(log_n: int):
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry
+
+    geom = CSGeometry(8, 0, 6, 4)
+    cs = ConstraintSystem(geom, 1 << log_n)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geom)
+    for _ in range(((1 << log_n) - 8) * per_row):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    return cs
+
+
+def build_sha256(num_bytes: int):
+    from boojum_tpu.cs.implementations import ConstraintSystem
+    from boojum_tpu.cs.types import CSGeometry, LookupParameters
+    from boojum_tpu.gadgets import allocate_u8_input, sha256
+
+    geom = CSGeometry(60, 0, 8, 7)
+    capacity = 1 << max(17, (num_bytes // 8192).bit_length() + 16)
+    cs = ConstraintSystem(
+        geom, capacity,
+        lookup_params=LookupParameters(width=4, num_repetitions=8),
+    )
+    data = bytes(i % 255 for i in range(num_bytes))
+    sha256(cs, allocate_u8_input(cs, data))
+    return cs
+
+
+def _job_parts(spec: dict):
+    """(assembly, setup, config) for one job spec; setup generation is
+    the caller's cost, exactly as for a direct prove."""
+    from boojum_tpu.prover import ProofConfig, generate_setup
+
+    kind = spec.get("circuit", "fma")
+    if kind == "sha256":
+        cs = build_sha256(int(spec.get("bytes", 8192)))
+        lde_default = 8
+    else:
+        cs = build_fma(int(spec.get("log_n", 10)))
+        lde_default = 2
+    config = ProofConfig(
+        fri_lde_factor=int(spec.get("lde", lde_default)),
+        merkle_tree_cap_size=int(spec.get("cap", 4)),
+        num_queries=int(spec.get("queries", 4)),
+        fri_final_degree=int(spec.get("final_degree", 16)),
+    )
+    asm = cs.into_assembly()
+    return asm, generate_setup(asm, config), config
+
+
+def demo_jobs(n: int) -> list[dict]:
+    """A mixed demo batch: two geometries, alternating lanes, so the
+    queue buckets, the scheduler sees occupancy, and the cache manager
+    sees both hits and misses."""
+    jobs = []
+    for i in range(n):
+        jobs.append(
+            {
+                "circuit": "fma",
+                "log_n": 10 if i % 3 else 11,
+                "priority": "interactive" if i == n - 1 else "batch",
+            }
+        )
+    return jobs
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="prove_service.py",
+        description="Drain proving jobs through boojum_tpu/service/",
+    )
+    ap.add_argument("--demo", type=int, metavar="N",
+                    help="enqueue N mixed demo jobs")
+    ap.add_argument("--jobs", metavar="JOBS_JSON",
+                    help="job spec file (JSON list)")
+    ap.add_argument("--report", metavar="OUT_JSONL",
+                    help="per-request SLO report path "
+                         "(default: BOOJUM_TPU_REPORT)")
+    ap.add_argument("--verify", action="store_true",
+                    help="verify every proof after the drain")
+    args = ap.parse_args(argv)
+    if not args.demo and not args.jobs:
+        ap.print_usage()
+        return 2
+
+    from boojum_tpu.service import (
+        ProvingService,
+        QueueFullError,
+        ServiceConfig,
+    )
+
+    cfg = ServiceConfig.from_env()
+    if args.report:
+        cfg.report_path = args.report
+    svc = ProvingService(cfg)
+    print(
+        f"service up: {len(svc.devices)} devices, "
+        f"mesh={None if svc.mesh is None else dict(svc.mesh.shape)}, "
+        f"queue cap {svc.queue.capacity}, "
+        f"cache cap {svc.cache.capacity_bytes >> 20} MiB, "
+        f"precompile={svc.warmer.mode}",
+        file=sys.stderr,
+    )
+
+    specs = demo_jobs(args.demo) if args.demo else json.load(open(args.jobs))
+    requests = []
+    # one (assembly, setup) per distinct circuit spec; repeated specs
+    # re-submit the same pair — that is the device-cache hit path
+    parts_cache: dict[str, tuple] = {}
+    for spec in specs:
+        key = json.dumps(
+            {k: v for k, v in spec.items() if k not in ("priority", "count")},
+            sort_keys=True,
+        )
+        if key not in parts_cache:
+            parts_cache[key] = _job_parts(spec)
+        asm, setup, config = parts_cache[key]
+        for _ in range(int(spec.get("count", 1))):
+            submit = lambda: svc.submit(  # noqa: E731
+                asm, setup, config,
+                priority=spec.get("priority", "batch"),
+                tenant=spec.get("tenant", "default"),
+            )
+            try:
+                requests.append(submit())
+            except QueueFullError:
+                # backpressure: drain the admitted work, then resubmit —
+                # the in-process analogue of a client's retry-after
+                print(
+                    f"queue full at {svc.queue.capacity}: draining before "
+                    "resubmitting",
+                    file=sys.stderr,
+                )
+                svc.run_worker()
+                requests.append(submit())
+    summary = svc.run_worker()
+    print(json.dumps(summary))
+
+    failed = [r for r in requests if r.error is not None]
+    for r in failed:
+        print(f"{r.id}: FAILED {r.error!r}", file=sys.stderr)
+    if args.verify and not failed:
+        from boojum_tpu.prover import verify
+
+        for r in requests:
+            assert verify(
+                r.setup.vk, r.proof, r.assembly.gates
+            ), f"{r.id}: proof did not verify"
+        print(f"verified {len(requests)} proofs", file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
