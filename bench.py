@@ -27,10 +27,24 @@ rides along on every JSON line and is written to BENCH_LEDGER_JSON, so a
 timeout is diagnosable from the JSON alone and compile-bill regressions
 are visible across rounds.
 
+AOT artifacts (ISSUE 8): with BOOJUM_TPU_AOT_DIR set the bench consults
+the artifact store (boojum_tpu/prover/aot.py) before anything traces —
+a matching pre-built bundle replaces the precompile sweep outright, the
+warm phase becomes O(deserialization), and the ledger attributes it via
+aot_hits/aot_deserialize_s instead of compile seconds. Build the bundle
+once per (circuit, config, platform) with `--build-artifacts` (or
+scripts/build_artifacts.py) and every later cold process skips the
+compile bill entirely.
+
 Usage: python bench.py [--precompile-only] [--no-precompile] [--service]
+                       [--build-artifacts]
   --precompile-only runs synthesis + the parallel precompile, emits the
   ledger JSON line and exits — a cache-warming step to run before a bench
   or a multihost round.
+  --build-artifacts runs synthesis + the full AOT bundle build (kernel
+  library + setup + one capture prove, persistent cache redirected into
+  the bundle) under BOOJUM_TPU_AOT_DIR (default ./aot_artifacts), emits
+  the ledger line and exits.
   --no-precompile skips the pre-prove parallel precompile sweep (the
   sweep runs BY DEFAULT before the warm-up prove: round 4's watchdog
   burned the whole budget on serial cold compiles, so BENCH lines never
@@ -101,15 +115,31 @@ def _log(msg):
           file=sys.stderr, flush=True)
 
 
-def _prune_bench_caches(root):
+def _prune_bench_caches(root, exclude=None):
     """Size-capped prune of every repo-local .jax_cache_bench_* dir.
 
     jax_persistent_cache_min_compile_time_secs=0.0 below persists EVERY
     graph (~500 per 2^16 prove) with no eviction of its own, so across
     shapes and rounds the bench caches grow without bound (ADVICE.md
     round 4). Above BENCH_CACHE_MAX_BYTES per dir (default 8 GiB, 0
-    disables) the oldest entries by mtime are deleted until under budget —
-    evicting a live entry only costs its recompile."""
+    disables) the oldest entries are deleted until under budget.
+
+    Two classes of entry are NEVER evicted, whatever their age:
+
+    - anything touched since THIS process started (mtime or atime — the
+      LRU cache's `-atime` sibling files — at/after _T0): the current
+      run's shape bucket, which the precompile/AOT warm phase has just
+      read or written. The prune therefore runs AFTER that phase (main()
+      calls it), not at import time — an import-time prune used to be
+      able to evict the very entries the run was about to need, turning
+      a warm round cold;
+    - entries installed from a loaded AOT artifact bundle
+      (prover/aot.py tracks the basenames): evicting those silently
+      re-opens the compile bill the bundle exists to close.
+
+    Entries are pruned as whole `<key>-cache`/`<key>-atime` STEMS
+    (oldest stem first, by its newest file) — the old per-file pass
+    could delete a `-cache` file and orphan its `-atime` sibling."""
     try:
         budget = float(
             os.environ.get("BENCH_CACHE_MAX_BYTES", str(8 << 30))
@@ -118,11 +148,21 @@ def _prune_bench_caches(root):
         budget = float(8 << 30)
     if budget <= 0:
         return
+    protected_names = set()
+    try:
+        from boojum_tpu.prover import aot as _aot
+
+        protected_names = _aot.loaded_cache_files()
+    except Exception:
+        pass
+    t0_epoch = time.time() - (time.perf_counter() - _T0)
     for d in sorted(os.listdir(root)):
         cache_dir = os.path.join(root, d)
         if not d.startswith(".jax_cache_bench_") or not os.path.isdir(cache_dir):
             continue
-        entries = []
+        if d == exclude:
+            continue
+        stems: dict = {}  # stem -> [newest_ts, size, paths, protected]
         total = 0
         for base, _dirs, files in os.walk(cache_dir):
             for fname in files:
@@ -131,23 +171,48 @@ def _prune_bench_caches(root):
                     st = os.stat(p)
                 except OSError:
                     continue
-                entries.append((st.st_mtime, st.st_size, p))
+                stem = fname
+                for suffix in ("-cache", "-atime"):
+                    if stem.endswith(suffix):
+                        stem = stem[: -len(suffix)]
+                        break
+                ts = max(st.st_mtime, st.st_atime)
+                ent = stems.setdefault(stem, [0.0, 0, [], False])
+                ent[0] = max(ent[0], ts)
+                ent[1] += st.st_size
+                ent[2].append((p, st.st_size))
+                if fname in protected_names or ts >= t0_epoch:
+                    ent[3] = True
                 total += st.st_size
         if total <= budget:
             continue
-        entries.sort()  # oldest first
+        order = sorted(stems.values())  # oldest stem first
         freed = 0
-        for _mtime, size, p in entries:
+        kept_protected = 0
+        for ts, size, paths, protected in order:
             if total - freed <= budget:
                 break
-            try:
-                os.remove(p)
-                freed += size
-            except OSError:
-                pass
+            if protected:
+                kept_protected += 1
+                continue
+            for p, sz in paths:
+                try:
+                    os.remove(p)
+                except OSError:
+                    # only count bytes ACTUALLY freed — a failed remove
+                    # (permissions, concurrent prune) must not satisfy
+                    # the budget on paper while the dir stays over cap
+                    continue
+                freed += sz
         _log(
             f"pruned {freed / 2**20:.0f} MiB from {d} "
-            f"({total / 2**20:.0f} MiB > cap {budget / 2**20:.0f} MiB)"
+            f"({total / 2**20:.0f} MiB > cap {budget / 2**20:.0f} MiB"
+            + (
+                f"; kept {kept_protected} protected stems"
+                if kept_protected
+                else ""
+            )
+            + ")"
         )
 
 
@@ -182,7 +247,13 @@ def _enable_compile_cache():
             or "default"
         )
         cache = os.path.join(_root, f".jax_cache_bench_{plat}_{_fp}")
-        _prune_bench_caches(_root)
+        # at import time, prune every OTHER platform/host's bench cache
+        # (bounding growth for import-only consumers like
+        # scripts/sha2_20_driver.py); THIS process's dir is pruned
+        # later, in main() after the precompile/AOT warm phase, when
+        # the entries the run needs carry fresh timestamps — the old
+        # import-time prune of the current dir could evict them
+        _prune_bench_caches(_root, exclude=os.path.basename(cache))
         jax.config.update("jax_compilation_cache_dir", cache)
         # cache EVERYTHING: behind the tunnel even a "cheap" compile is a
         # multi-second RPC, and a fresh process re-pays it for every graph
@@ -256,12 +327,17 @@ _LIVE_SINK = {"sink": None}
 # their elapsed wall — instead of an empty stage split, so a timeout
 # localizes to the exact sub-stage that stalled (BENCH_r04 gave
 # `"stages": {}` and no localization at all). _prove_recorded installs a
-# recorder for EVERY prove, with or without BOOJUM_TPU_REPORT.
-_LIVE_REC = {"rec": None}
+# recorder for EVERY prove, with or without BOOJUM_TPU_REPORT. "bench"
+# holds the BENCH-LIFETIME recorder main() installs before the first
+# phase: a watchdog line fired OUTSIDE a prove (precompile / AOT load /
+# setup — exactly where BENCH_r03/r04 burned their budgets) falls back
+# to it, so those phases' spans (precompile_compile_pool, aot_load,
+# aot_warm, setup stages) localize the stall too.
+_LIVE_REC = {"rec": None, "bench": None}
 
 
 def _partial_span_tree():
-    rec = _LIVE_REC["rec"]
+    rec = _LIVE_REC["rec"] or _LIVE_REC["bench"]
     if rec is None:
         return None
     try:
@@ -548,6 +624,15 @@ def main():
 
     from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
     from boojum_tpu.utils.profiling import collect_stages, stop_collecting_stages
+    from boojum_tpu.utils import spans as _spans
+
+    # bench-lifetime span recorder: the per-prove recorders of
+    # _prove_recorded install OVER it (and restore it after), so a
+    # watchdog line fired in ANY phase — precompile, AOT load, setup —
+    # carries a span tree instead of "stages": {}
+    bench_rec = _spans.SpanRecorder(sync=False)
+    _LIVE_REC["bench"] = bench_rec
+    _spans.install_recorder(bench_rec)
 
     circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
     reps = int(os.environ.get("BENCH_REPS", "3"))
@@ -582,12 +667,65 @@ def main():
         os.environ.setdefault("BOOJUM_TPU_SYNC_SWEEPS", "1")
         _log("large trace: defaulting BOOJUM_TPU_SYNC_SWEEPS=1")
 
+    if "--build-artifacts" in sys.argv:
+        # AOT build step: compile the whole dispatch surface (kernel
+        # library + setup + one full prove) into a deployment bundle
+        # under BOOJUM_TPU_AOT_DIR (default ./aot_artifacts), emit the
+        # ledger line and exit — after this, a cold process proves with
+        # zero XLA compiles (see BASELINE.md "AOT artifact protocol")
+        _STATE["phase"] = "build_artifacts"
+        from boojum_tpu.prover import aot as _aot
+
+        out_root = _aot.aot_dir() or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "aot_artifacts"
+        )
+        workers = int(os.environ.get("BENCH_PRECOMPILE_WORKERS", "8"))
+        _log(f"building AOT artifact bundle under {out_root}")
+        manifest = _aot.build_bundle(
+            asm, config, out_root, ledger=_LEDGER, max_workers=workers
+        )
+        _log(
+            f"bundle {manifest['dir']}: {manifest['num_kernels']} kernels"
+            f" ({manifest['num_exports']} exported), "
+            f"{manifest['cache_bytes'] / 2**20:.1f} MiB cache"
+        )
+        _prune_bench_caches(os.path.dirname(os.path.abspath(__file__)))
+        _emit("build_artifacts")
+        return
+
     precompile_only = "--precompile-only" in sys.argv
     no_precompile = (
         "--no-precompile" in sys.argv
         or os.environ.get("BENCH_PRECOMPILE", "").strip() == "0"
     )
-    if precompile_only or not no_precompile:
+    aot_warmed = False
+    if os.environ.get("BOOJUM_TPU_AOT_DIR", "").strip():
+        # artifact store first: a bundle hit replaces the precompile
+        # sweep outright — the warm phase becomes O(deserialization) and
+        # each kernel's ledger entry carries aot_hit, so the warm-up
+        # wall on this run's JSON line is attributed to deserialization
+        # rather than compilation
+        _STATE["phase"] = "aot_load"
+        from boojum_tpu.prover import aot as _aot
+
+        try:
+            stats = _aot.load_and_warm(
+                _aot.aot_dir(), asm, config, ledger=_LEDGER
+            )
+        except _aot.AotBundleError:
+            # BOOJUM_TPU_AOT_REQUIRE: a missing/stale bundle is a hard
+            # failure, not a silent fall-through to the compile bill
+            raise
+        except Exception as e:  # noqa: BLE001 — an unexpected loader
+            # bug must degrade to the precompile sweep, not kill the run
+            _log(f"aot load failed (continuing to precompile): {e!r}")
+            stats = None
+        if stats is not None and not stats.get("aborted"):
+            aot_warmed = True
+            _log(f"aot warm done: {json.dumps(stats)}")
+        else:
+            _log("no usable AOT bundle; falling back to precompile sweep")
+    if (precompile_only or not no_precompile) and not aot_warmed:
         # overlap the remote compile round-trips BEFORE the first dispatch
         # pays them serially; everything lands in the persistent cache
         _STATE["phase"] = "precompile"
@@ -607,6 +745,10 @@ def main():
             if precompile_only:
                 raise
             _log(f"precompile failed (continuing to prove): {e!r}")
+    # prune AFTER the warm phase: entries this run just read/wrote (and
+    # any artifact-bundle installs) carry fresh timestamps and survive;
+    # an import-time prune could evict the current bucket's entries
+    _prune_bench_caches(os.path.dirname(os.path.abspath(__file__)))
     if precompile_only:
         _emit("precompile_only")
         return

@@ -1,0 +1,929 @@
+"""AOT executable artifact store — compilation as a build step (ISSUE 8).
+
+Rounds 3 and 4 of the bench burned the whole 1500 s watchdog budget
+inside cold-cache warm-up compiles: a fresh process pays the full XLA
+bill for the kernel library before its first prove, which is fatal for a
+production prover (ROADMAP item 1) and has kept every PR 3-5 perf win
+unmeasured. ICICLE (PAPERS.md) ships precompiled device kernels as
+deployment artifacts; DIZK's fleet amortization only works when
+per-process startup is cheap. This module makes compilation a BUILD
+step:
+
+- `build_bundle(assembly, config, out_root)` compiles the whole
+  enumerated kernel library (`precompile.enumerate_kernels`) with the
+  persistent compilation cache redirected into a bundle directory, then
+  runs `generate_setup` + one full `prove` under the same redirect so
+  every graph a cold serve process will dispatch — including the setup
+  pipeline and the query-phase graphs `enumerate_kernels` deliberately
+  skips — lands in the bundle. Each kernel is additionally serialized as
+  a `jax.export` StableHLO artifact where exportable (Pallas custom
+  calls may refuse; those entries fall back to cache-bundle-only, which
+  is recorded per kernel in the manifest). A `manifest.json` carries the
+  bundle key, jax/jaxlib versions, platform fingerprint and a sha256
+  per artifact file.
+
+- `load_bundle(out_root, assembly, config)` finds the bundle for this
+  (ShapeBucket.key, mesh shape, flag variant), validates versions /
+  platform / integrity hashes, and copies the cache entries into the
+  process's active persistent-cache directory — so every later compile
+  of a bundled kernel is a cache DESERIALIZATION, not an XLA compile.
+  A version-mismatched, corrupt or missing bundle logs a warning and
+  returns None (graceful JIT fallback) unless BOOJUM_TPU_AOT_REQUIRE is
+  set, in which case it raises — production deployments where silent
+  JIT means an SLO breach opt into the hard failure.
+
+- `warm_from_bundle(assembly, config)` re-lowers the enumerated library
+  serially and `.compile()`s each kernel, classifying it `aot_hit`
+  (persistent-cache deserialization, zero misses escaped to the
+  compiler) or miss by diffing the jax.monitoring cache counters around
+  each compile. Every kernel lands in the CompileLedger with an
+  `aot_hit` field, and the `aot.*` metrics (hits / misses /
+  deserialize_s) make the warm-up bill attributable to deserialization
+  rather than compilation on every bench/report line.
+
+Key identity: a bundle serves exactly one
+``(ShapeBucket.key, mesh_shape, flag variant)`` triple — the same
+bucket key the admission queue and compile ledger use
+(prover/shape_key.py) plus the env-flag variant that decides WHICH
+kernel set `enumerate_kernels` derives (overlap / limb-sweep /
+stream-LDE threshold / mesh mode). jax+jaxlib versions and the platform
+fingerprint are validated at LOAD time rather than folded into the
+directory name, so a version bump reads as "stale bundle" in the logs
+instead of a silent miss.
+
+Honest scope note: `jax.export` artifacts carry lowered StableHLO —
+portable and auditable, but re-compiled by XLA on any consumer. The
+persistent-cache entries carry the COMPILED executable and are what
+makes a matching process zero-compile; they are only valid on an
+exactly-matching (jax, jaxlib, backend, device kind, device count,
+host CPU) stack, which the manifest records and the loader enforces.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import time
+from dataclasses import dataclass, field
+
+from ..utils import metrics as _metrics
+from ..utils.profiling import (
+    CompileLedger,
+    current_compile_ledger,
+    log as _log,
+)
+from ..utils.spans import span as _span
+
+AOT_KIND = "boojum_tpu.aot_bundle"
+AOT_SCHEMA = 1
+MANIFEST_NAME = "manifest.json"
+
+# platform fields that must match EXACTLY between build and load for the
+# compiled cache entries to be usable: the persistent-cache key covers
+# jax/backend identity, and XLA:CPU AOT code additionally embeds the
+# compile host's vector features (_hostfp.py — loading a mismatched
+# entry SIGILLs rather than missing)
+_PLATFORM_FIELDS = (
+    "jax", "jaxlib", "backend", "device_kind", "num_devices", "host_fp"
+)
+
+
+class AotBundleError(RuntimeError):
+    """A required artifact bundle is missing, stale or corrupt
+    (BOOJUM_TPU_AOT_REQUIRE=1 turns the JIT fallback into this error)."""
+
+
+def aot_dir() -> str | None:
+    """BOOJUM_TPU_AOT_DIR: root directory of artifact bundles (None =
+    the AOT layer is off and every consult is a no-op)."""
+    return os.environ.get("BOOJUM_TPU_AOT_DIR", "").strip() or None
+
+
+def aot_require() -> bool:
+    """BOOJUM_TPU_AOT_REQUIRE: a missing/stale/corrupt bundle raises
+    AotBundleError instead of falling back to JIT (default off)."""
+    from ..utils.transfer import env_flag
+
+    return env_flag("BOOJUM_TPU_AOT_REQUIRE", False)
+
+
+def aot_warm_enabled() -> bool:
+    """BOOJUM_TPU_AOT_WARM: after a bundle load, re-lower + compile the
+    enumerated library so every kernel's cache deserialization happens
+    up front WITH per-kernel aot_hit ledger attribution (default on;
+    off = first dispatch of each kernel pays its own cache load)."""
+    from ..utils.transfer import env_flag
+
+    return env_flag("BOOJUM_TPU_AOT_WARM", True)
+
+
+def aot_export_enabled() -> bool:
+    """BOOJUM_TPU_AOT_EXPORT: also serialize a jax.export StableHLO
+    artifact per kernel at build time (default on; the portable,
+    auditable representation — the cache entries alone already make a
+    matching process zero-compile)."""
+    from ..utils.transfer import env_flag
+
+    return env_flag("BOOJUM_TPU_AOT_EXPORT", True)
+
+
+# ---------------------------------------------------------------------------
+# Bundle identity
+# ---------------------------------------------------------------------------
+
+
+def _mesh_shape_list(mesh_shape) -> list | None:
+    """Normalize a mesh spec — None, a (ncol, nrow) pair, or a built Mesh
+    — to a JSON-stable [ncol, nrow] list (None = meshless)."""
+    if mesh_shape is None:
+        return None
+    if isinstance(mesh_shape, (tuple, list)):
+        return [int(mesh_shape[0]), int(mesh_shape[1])]
+    sh = dict(mesh_shape.shape)
+    return [int(sh.get("col", 1)), int(sh.get("row", 1))]
+
+
+def variant_fingerprint(mesh_shape=None) -> dict:
+    """The env-flag variant that decides WHICH kernel set
+    `precompile.enumerate_kernels` derives — resolved the same way the
+    enumeration resolves it, so build and load can never disagree by
+    parsing flags differently."""
+    from ..utils import transfer as _transfer
+    from .pallas_sweep import limb_sweep_enabled
+    from .streaming import stream_threshold_bytes
+
+    thresh = stream_threshold_bytes()
+    return {
+        "overlap": bool(_transfer.overlap_enabled()),
+        "limb_sweep": bool(limb_sweep_enabled()),
+        "mesh_shape": _mesh_shape_list(mesh_shape),
+        # inf is not JSON — the "streaming forced off" sentinel string is
+        "stream_lde_bytes": (
+            "off" if thresh == float("inf") else float(thresh)
+        ),
+    }
+
+
+def platform_info() -> dict:
+    """The exact-match stack identity the compiled cache entries are
+    valid on (manifest-recorded, load-validated)."""
+    import jax
+    import jaxlib
+
+    from .._hostfp import host_fingerprint
+
+    try:
+        dev = jax.devices()[0]
+        kind = getattr(dev, "device_kind", "unknown")
+    except Exception:
+        kind = "unknown"
+    try:
+        ndev = int(jax.device_count())
+    except Exception:
+        ndev = 0
+    return {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": kind,
+        "num_devices": ndev,
+        "host_fp": host_fingerprint(),
+    }
+
+
+def bundle_name(bucket_key: str, variant: dict) -> str:
+    """Directory name of the bundle serving one (bucket, variant) pair:
+    the bucket's short fingerprint (shape_key.key_fingerprint — the one
+    fs-safe short form of "same shape", greppable back to a bucket)
+    plus a digest of the full identity."""
+    from .shape_key import key_fingerprint
+
+    ident = json.dumps([bucket_key, variant], sort_keys=True)
+    digest = hashlib.sha256(ident.encode()).hexdigest()[:16]
+    return f"bundle-{key_fingerprint(bucket_key)}-{digest}"
+
+
+def bundle_dir_for(
+    out_root: str, assembly, config, mesh_shape=None
+) -> str:
+    from .shape_key import bucket_key
+
+    return os.path.join(
+        out_root,
+        bundle_name(
+            bucket_key(assembly, config), variant_fingerprint(mesh_shape)
+        ),
+    )
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _safe_kernel_filename(name: str) -> str:
+    """Kernel names carry shape/oracle punctuation (wit:mono_sm,
+    fri_fold_limb_k2) — map to a fs-safe unique filename."""
+    stem = "".join(c if c.isalnum() or c in "._-" else "_" for c in name)
+    tag = hashlib.blake2s(name.encode(), digest_size=4).hexdigest()
+    return f"{stem}-{tag}.jaxexport"
+
+
+# ---------------------------------------------------------------------------
+# Persistent-cache plumbing
+# ---------------------------------------------------------------------------
+
+
+def _strip_path_keyed_options():
+    """Make compiled cache entries PORTABLE across cache directories.
+
+    jax 0.4.36+ injects the persistent-cache DIRECTORY PATH into every
+    compile's options (jax_persistent_cache_enable_xla_caches enables
+    the GPU autotune/kernel caches at `<cache_dir>/...`, and that path
+    lands in debug_options, which the cache key hashes) — so an
+    executable compiled under the bundle's cache dir could never be a
+    hit under a consumer's cache dir. Every AOT flow — build, load,
+    warm — forces the injection off, on BOTH sides of the bundle;
+    the GPU-only caches it would enable are irrelevant on the CPU/TPU
+    backends this prover targets. Deliberately sticky (not restored):
+    the consumer's later setup/prove lowerings must keep producing
+    bundle-portable keys, and flipping mid-process would split the
+    process's own cache in two."""
+    try:
+        import jax
+
+        jax.config.update("jax_persistent_cache_enable_xla_caches", "none")
+    except Exception:
+        pass
+
+
+def _reset_persistent_cache():
+    """Drop jax's process-wide persistent-cache singleton so the next
+    compile re-reads jax_compilation_cache_dir (the documented way to
+    repoint the cache mid-process)."""
+    try:
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+class _redirected_cache:
+    """Context manager: point the persistent compilation cache at
+    `cache_dir` with persist-everything thresholds, restoring the
+    previous configuration (and cache singleton) on exit."""
+
+    def __init__(self, cache_dir: str):
+        self.cache_dir = cache_dir
+
+    def __enter__(self):
+        import jax
+
+        self._prev = {
+            "jax_compilation_cache_dir":
+                jax.config.jax_compilation_cache_dir,
+            "jax_persistent_cache_min_compile_time_secs":
+                jax.config.jax_persistent_cache_min_compile_time_secs,
+            "jax_persistent_cache_min_entry_size_bytes":
+                jax.config.jax_persistent_cache_min_entry_size_bytes,
+        }
+        jax.config.update("jax_compilation_cache_dir", self.cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        _strip_path_keyed_options()
+        _reset_persistent_cache()
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+
+        for k, v in self._prev.items():
+            jax.config.update(k, v)
+        _reset_persistent_cache()
+        return False
+
+
+def _active_cache_dir() -> str | None:
+    """The process's persistent-cache directory, configuring the
+    package default when nothing pinned one yet (a loader without a
+    destination cache has nowhere to put the compiled artifacts)."""
+    import jax
+
+    d = jax.config.jax_compilation_cache_dir
+    if d:
+        os.makedirs(d, exist_ok=True)
+        return d
+    if os.environ.get("BOOJUM_TPU_NO_COMPILE_CACHE"):
+        return None
+    from .._hostfp import host_fingerprint
+
+    plat = (
+        os.environ.get("JAX_PLATFORMS", "").strip().replace(",", "-")
+        or "default"
+    )
+    d = os.environ.get(
+        "BOOJUM_TPU_COMPILE_CACHE",
+        os.path.expanduser(
+            f"~/.cache/boojum_tpu_xla-{plat}-{host_fingerprint()}"
+        ),
+    )
+    jax.config.update("jax_compilation_cache_dir", d)
+    _reset_persistent_cache()
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+# monitoring-fed cache hit/miss counters for per-kernel warm attribution
+# (jax.monitoring offers registration but no deregistration, so ONE
+# module-lifetime listener feeds a pair of counters the warm loop diffs
+# around each serial compile)
+_CACHE_EVENTS = {"hits": 0, "misses": 0}
+_LISTENER_INSTALLED = False
+
+
+def _install_cache_listener():
+    global _LISTENER_INSTALLED
+    if _LISTENER_INSTALLED:
+        return
+    try:
+        from jax import monitoring as _mon
+
+        def _on_event(event, **kw):
+            if event == "/jax/compilation_cache/cache_hits":
+                _CACHE_EVENTS["hits"] += 1
+            elif event == "/jax/compilation_cache/cache_misses":
+                _CACHE_EVENTS["misses"] += 1
+
+        _mon.register_event_listener(_on_event)
+        _LISTENER_INSTALLED = True
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Build
+# ---------------------------------------------------------------------------
+
+
+# True while build_bundle is capturing its own setup+prove: prove()'s
+# AOT consult (maybe_load_for_prove) is suppressed for the duration so a
+# previous bundle can never leak entries into the one being built
+_BUILDING = [False]
+
+
+def build_bundle(
+    assembly,
+    config,
+    out_root: str,
+    mesh_shape=None,
+    ledger: CompileLedger | None = None,
+    max_workers: int = 8,
+    include_prove: bool = True,
+) -> dict:
+    """Build one artifact bundle for (assembly, config, mesh_shape) under
+    `out_root` and return its manifest (with a "dir" key added).
+
+    The whole compile surface runs with the persistent cache redirected
+    into the bundle: the parallel `precompile` sweep of the enumerated
+    library first (per-kernel ledger attribution), then — with
+    `include_prove` — `generate_setup` and one full `prove`, which
+    captures the setup pipeline and the query-phase graphs the
+    enumeration deliberately skips, so a cold consumer process compiles
+    NOTHING. The bundle is built in a temp directory and atomically
+    renamed into place; a torn build never shadows a good bundle."""
+    from .precompile import enumerate_kernels, precompile
+    from .shape_key import shape_bucket
+
+    if ledger is None:
+        ledger = current_compile_ledger() or CompileLedger()
+    sb = shape_bucket(assembly, config)
+    variant = variant_fingerprint(mesh_shape)
+    final_dir = os.path.join(out_root, bundle_name(sb.key, variant))
+    tmp_dir = f"{final_dir}.tmp{os.getpid()}"
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    cache_dir = os.path.join(tmp_dir, "cache")
+    exports_dir = os.path.join(tmp_dir, "exports")
+    os.makedirs(cache_dir)
+    os.makedirs(exports_dir)
+
+    t0 = time.perf_counter()
+    _BUILDING[0] = True
+    try:
+        with _span("aot_build", shape=sb.key):
+            specs = enumerate_kernels(
+                assembly, config, mesh_shape=mesh_shape
+            )
+            with _redirected_cache(cache_dir):
+                precompile(
+                    assembly, config, max_workers=max_workers,
+                    ledger=ledger, mesh_shape=mesh_shape, specs=specs,
+                )
+                if include_prove:
+                    # the setup + prove graphs NOT in the enumeration
+                    # (setup pipeline, fused query gather, streamed
+                    # single-column opens, Merkle tail) — run once so
+                    # they land in the bundle too; witness values ride
+                    # on the assembly
+                    from . import prover as P
+                    from .setup import generate_setup
+
+                    with _span("aot_build_prove", shape=sb.key):
+                        setup = generate_setup(assembly, config)
+                        if mesh_shape is not None:
+                            from ..parallel.shard_sweep import (
+                                mesh_from_shape,
+                            )
+
+                            mesh = (
+                                mesh_shape
+                                if not isinstance(
+                                    mesh_shape, (tuple, list)
+                                )
+                                else mesh_from_shape(mesh_shape)
+                            )
+                            P.prove(assembly, setup, config, mesh=mesh)
+                        else:
+                            P.prove(assembly, setup, config)
+
+            kernels = []
+            export_ok = 0
+            for spec in specs:
+                ent: dict = {"name": spec.name}
+                if aot_export_enabled():
+                    try:
+                        from jax import export as _export
+
+                        exp = _export.export(spec.fn)(*spec.args)
+                        data = exp.serialize()
+                        fname = _safe_kernel_filename(spec.name)
+                        fpath = os.path.join(exports_dir, fname)
+                        with open(fpath, "wb") as f:
+                            f.write(data)
+                        ent.update(
+                            kind="export",
+                            file=f"exports/{fname}",
+                            sha256=hashlib.sha256(data).hexdigest(),
+                            bytes=len(data),
+                        )
+                        export_ok += 1
+                    except Exception as e:  # noqa: BLE001 — Pallas
+                        # custom calls (and anything else jax.export
+                        # refuses) fall back to cache-bundle-only,
+                        # recorded per kernel
+                        ent.update(
+                            kind="cache_only", export_error=repr(e)[:200]
+                        )
+                else:
+                    ent["kind"] = "cache_only"
+                kernels.append(ent)
+
+            cache_entries = []
+            total_bytes = 0
+            for base, _dirs, files in os.walk(cache_dir):
+                for fname in sorted(files):
+                    p = os.path.join(base, fname)
+                    rel = os.path.relpath(p, tmp_dir)
+                    size = os.path.getsize(p)
+                    cache_entries.append(
+                        {
+                            "file": rel,
+                            "sha256": _sha256_file(p),
+                            "bytes": size,
+                        }
+                    )
+                    total_bytes += size
+
+            manifest = {
+                "kind": AOT_KIND,
+                "schema": AOT_SCHEMA,
+                "created_unix": round(time.time(), 3),
+                "bucket": sb.key,
+                "variant": variant,
+                "platform": platform_info(),
+                "num_kernels": len(specs),
+                "num_exports": export_ok,
+                "kernels": kernels,
+                "cache_entries": cache_entries,
+                "cache_bytes": total_bytes,
+                "build_wall_s": round(time.perf_counter() - t0, 3),
+            }
+            with open(os.path.join(tmp_dir, MANIFEST_NAME), "w") as f:
+                json.dump(manifest, f, indent=1)
+    except BaseException:
+        # a failed build must not litter multi-GiB bundle-*.tmp<pid>
+        # dirs next to live bundles (repeat failures would accumulate)
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
+    finally:
+        _BUILDING[0] = False
+
+    os.makedirs(out_root, exist_ok=True)
+    shutil.rmtree(final_dir, ignore_errors=True)
+    os.replace(tmp_dir, final_dir)
+    _metrics.count_aot("builds")
+    _log(
+        f"aot: built {final_dir} — {len(specs)} kernels "
+        f"({export_ok} exported), {len(cache_entries)} cache entries, "
+        f"{total_bytes / 2**20:.1f} MiB, "
+        f"{manifest['build_wall_s']:.1f}s"
+    )
+    manifest["dir"] = final_dir
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# Load
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LoadedBundle:
+    """One successfully loaded bundle: where it came from, which cache
+    files were installed into the process cache dir, and what was
+    skipped as corrupt."""
+
+    dir: str
+    manifest: dict
+    cache_files: list[str] = field(default_factory=list)
+    skipped: int = 0
+    load_s: float = 0.0
+
+
+# cache-entry basenames installed by any load this process performed —
+# bench.py's size-capped prune consults this so artifact-backed entries
+# are never evicted out from under the run that loaded them
+_LOADED_CACHE_FILES: set[str] = set()
+
+
+def loaded_cache_files() -> set[str]:
+    return set(_LOADED_CACHE_FILES)
+
+
+def load_bundle(
+    out_root: str,
+    assembly,
+    config,
+    mesh_shape=None,
+    require: bool | None = None,
+) -> LoadedBundle | None:
+    """Find, validate and install the bundle for (assembly, config,
+    mesh_shape). Returns None — after a logged warning — when the bundle
+    is missing, version/platform-stale or has a corrupt manifest, so the
+    caller falls back to plain JIT; BOOJUM_TPU_AOT_REQUIRE (or
+    `require=True`) raises AotBundleError instead. Individually corrupt
+    cache entries are skipped (their kernels JIT-compile) rather than
+    rejecting the whole bundle."""
+    from .shape_key import bucket_key
+
+    if require is None:
+        require = aot_require()
+
+    def _fail(event: str, msg: str):
+        _metrics.count_aot(event)
+        if require:
+            raise AotBundleError(msg)
+        _log(f"aot: {msg} — falling back to JIT compilation")
+        return None
+
+    key = bucket_key(assembly, config)
+    variant = variant_fingerprint(mesh_shape)
+    bdir = os.path.join(out_root, bundle_name(key, variant))
+    mpath = os.path.join(bdir, MANIFEST_NAME)
+    if not os.path.isfile(mpath):
+        return _fail(
+            "bundle_misses",
+            f"no artifact bundle for bucket {key} "
+            f"(variant {variant}) under {out_root}",
+        )
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except Exception as e:  # noqa: BLE001
+        return _fail(
+            "corrupt_bundles", f"unreadable manifest {mpath}: {e!r}"
+        )
+    if (
+        manifest.get("kind") != AOT_KIND
+        or manifest.get("schema") != AOT_SCHEMA
+    ):
+        return _fail(
+            "corrupt_bundles",
+            f"{mpath}: kind/schema mismatch "
+            f"({manifest.get('kind')!r}/{manifest.get('schema')!r})",
+        )
+    plat = platform_info()
+    mplat = manifest.get("platform") or {}
+    stale = [
+        f"{k}: bundle {mplat.get(k)!r} vs process {plat.get(k)!r}"
+        for k in _PLATFORM_FIELDS
+        if mplat.get(k) != plat.get(k)
+    ]
+    if stale:
+        return _fail(
+            "stale_bundles",
+            f"stale bundle {bdir} ({'; '.join(stale)})",
+        )
+    dest = _active_cache_dir()
+    if dest is None:
+        return _fail(
+            "bundle_misses",
+            "no persistent compilation cache available "
+            "(BOOJUM_TPU_NO_COMPILE_CACHE set?) — artifact cache "
+            "entries have nowhere to install",
+        )
+
+    # from here on this process is consuming the bundle: its own
+    # lowerings must produce bundle-portable cache keys
+    _strip_path_keyed_options()
+    t0 = time.perf_counter()
+    installed: list[str] = []
+    skipped = 0
+    total_bytes = 0
+    with _span("aot_load", bundle=os.path.basename(bdir)):
+        for ent in manifest.get("cache_entries", ()):
+            src = os.path.join(bdir, ent["file"])
+            try:
+                if _sha256_file(src) != ent["sha256"]:
+                    raise ValueError("sha256 mismatch")
+            except Exception as e:  # noqa: BLE001
+                skipped += 1
+                _metrics.count_aot("corrupt_entries")
+                _log(
+                    f"aot: skipping corrupt artifact {ent['file']} "
+                    f"({e!r}) — its kernel will JIT-compile"
+                )
+                continue
+            base = os.path.basename(ent["file"])
+            dst = os.path.join(dest, base)
+            try:
+                if not os.path.exists(dst):
+                    tmp = f"{dst}.aot{os.getpid()}"
+                    shutil.copyfile(src, tmp)
+                    os.replace(tmp, dst)  # atomic: concurrent readers
+                    # never see a torn entry
+            except OSError as e:
+                # unwritable/full cache dir: the entry's kernel JITs;
+                # never turn a bundle install into a prove() crash
+                skipped += 1
+                _metrics.count_aot("install_errors")
+                _log(
+                    f"aot: could not install {base} into {dest} "
+                    f"({e!r}) — its kernel will JIT-compile"
+                )
+                continue
+            installed.append(base)
+            total_bytes += int(ent.get("bytes", 0))
+    load_s = time.perf_counter() - t0
+    _LOADED_CACHE_FILES.update(installed)
+    _metrics.count_aot("bundles_loaded")
+    _metrics.gauge_aot_add("load_s", load_s)
+    _metrics.gauge_aot_add("bundle_bytes", float(total_bytes))
+    _log(
+        f"aot: loaded {bdir} — {len(installed)} cache entries "
+        f"({total_bytes / 2**20:.1f} MiB) into {dest} in {load_s:.2f}s"
+        + (f", {skipped} corrupt skipped" if skipped else "")
+    )
+    return LoadedBundle(
+        dir=bdir, manifest=manifest, cache_files=installed,
+        skipped=skipped, load_s=round(load_s, 4),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Warm (per-kernel aot_hit attribution)
+# ---------------------------------------------------------------------------
+
+
+def warm_from_bundle(
+    assembly,
+    config,
+    mesh_shape=None,
+    ledger: CompileLedger | None = None,
+    specs=None,
+) -> dict:
+    """Lower + compile the enumerated kernel library SERIALLY, so each
+    kernel's persistent-cache hit/miss is attributable: the monitoring
+    cache counters are diffed around every `.compile()`, and the ledger
+    entry records `aot_hit` (deserialized from an artifact, zero misses
+    escaped to the compiler) or not. Serial is the right shape here —
+    lowering is GIL-bound Python either way and a warmed compile is a
+    local cache read, so there are no slow RPCs left to overlap.
+
+    Returns {"kernels", "aot_hits", "aot_misses", "deserialize_s"}."""
+    import jax
+
+    from .precompile import enumerate_kernels
+    from .shape_key import bucket_key
+
+    if ledger is None:
+        ledger = current_compile_ledger() or CompileLedger()
+    _install_cache_listener()
+    _strip_path_keyed_options()
+    shape = bucket_key(assembly, config)
+    if specs is None:
+        with _span("aot_warm_enumerate", shape=shape):
+            specs = enumerate_kernels(
+                assembly, config, mesh_shape=mesh_shape
+            )
+    cache_on = bool(jax.config.jax_compilation_cache_dir)
+
+    hits = misses = 0
+    aborted = False
+    # a couple of misses = a stale entry or two; once misses exceed
+    # this, the bundle's keys systematically mismatch and finishing the
+    # SERIAL loop would reproduce the cold-compile wall that killed
+    # BENCH_r03/r04 — bail out so the caller falls back to the
+    # PARALLEL precompile sweep (already-warmed kernels re-hit there)
+    miss_budget = max(2, len(specs) // 8)
+    deserialize_s = 0.0
+    # the warm compiles emit their own "Finished XLA compilation" log
+    # lines; suppress ledger log capture so dispatch_compiles keeps
+    # meaning "graphs that ESCAPED the artifact store"
+    ledger.suppress_log_capture = True
+    try:
+        with _span("aot_warm", kernels=len(specs), shape=shape):
+            for spec in specs:
+                t0 = time.perf_counter()
+                try:
+                    low = spec.fn.lower(*spec.args)
+                except Exception as e:  # noqa: BLE001
+                    ledger.record(
+                        spec.name, time.perf_counter() - t0, 0.0,
+                        error=repr(e), shape_key=shape,
+                    )
+                    continue
+                trace_s = time.perf_counter() - t0
+                m0 = _CACHE_EVENTS["misses"]
+                t1 = time.perf_counter()
+                try:
+                    low.compile()
+                except Exception as e:  # noqa: BLE001
+                    ledger.record(
+                        spec.name, trace_s, time.perf_counter() - t1,
+                        error=repr(e), shape_key=shape,
+                    )
+                    continue
+                dt = time.perf_counter() - t1
+                # hit = no persistent-cache MISS escaped to the
+                # compiler during this kernel's compile. A compile that
+                # raised neither event was deduplicated against an
+                # in-process executable (jax's in-memory compilation
+                # cache — e.g. two specs lowering to identical HLO),
+                # which also paid no XLA compile; the miss counter is
+                # the authoritative did-a-compile-escape signal, and
+                # the report validator cross-checks the process-wide
+                # ledger miss total against the all-hits claim.
+                hit = cache_on and _CACHE_EVENTS["misses"] == m0
+                ledger.record(
+                    spec.name, trace_s, dt, cache_hit=hit,
+                    shape_key=shape, aot_hit=hit,
+                )
+                if hit:
+                    hits += 1
+                    deserialize_s += dt
+                    _metrics.count_aot("hits")
+                    _metrics.gauge_aot_add("deserialize_s", dt)
+                else:
+                    misses += 1
+                    _metrics.count_aot("misses")
+                    # a miss here still needs the deserialize gauge
+                    # present for the report validator's schema
+                    _metrics.gauge_aot_add("deserialize_s", 0.0)
+                    if misses > miss_budget:
+                        aborted = True
+                        _log(
+                            f"aot: {misses} misses in {len(specs)} "
+                            f"kernels — bundle keys mismatch, aborting "
+                            f"the serial warm (caller falls back to "
+                            f"the parallel precompile sweep)"
+                        )
+                        break
+    finally:
+        ledger.suppress_log_capture = False
+    _log(
+        f"aot: warmed {len(specs)} kernels for {shape}: "
+        f"{hits} artifact hits, {misses} misses, "
+        f"deserialize {deserialize_s:.2f}s"
+    )
+    return {
+        "kernels": len(specs),
+        "aot_hits": hits,
+        "aot_misses": misses,
+        "aborted": aborted,
+        "deserialize_s": round(deserialize_s, 4),
+    }
+
+
+def load_and_warm(
+    out_root: str,
+    assembly,
+    config,
+    mesh_shape=None,
+    ledger: CompileLedger | None = None,
+) -> dict | None:
+    """The consumer entry: install the bundle's cache entries, then (per
+    BOOJUM_TPU_AOT_WARM) run the attributing warm pass. None = no usable
+    bundle, caller falls back to its JIT/precompile path.
+
+    Marks the (root, bucket, variant) triple as attempted: a later
+    prove() of the same bucket skips its own consult instead of paying
+    a SECOND full load + serial warm (bench.py and the service warmer
+    call this directly, then prove)."""
+    _mark_attempted(out_root, assembly, config, mesh_shape)
+    bundle = load_bundle(
+        out_root, assembly, config, mesh_shape=mesh_shape
+    )
+    if bundle is None:
+        return None
+    stats: dict = {"bundle": bundle.dir, "load_s": bundle.load_s,
+                   "skipped_entries": bundle.skipped}
+    if aot_warm_enabled():
+        stats.update(
+            warm_from_bundle(
+                assembly, config, mesh_shape=mesh_shape, ledger=ledger
+            )
+        )
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# prove() consult
+# ---------------------------------------------------------------------------
+
+
+def _would_shard_map(mesh) -> bool:
+    """Whether `prove(mesh=...)` will execute via shard_map — replicated
+    from parallel.sharding.mesh_mode WITHOUT needing the mesh active."""
+    if mesh is None:
+        return False
+    v = os.environ.get("BOOJUM_TPU_MESH_MODE", "").strip().lower()
+    if v in ("shard_map", "sm"):
+        return True
+    if v == "gspmd":
+        return False
+    try:
+        import jax
+
+        return jax.process_count() == 1
+    except Exception:
+        return False
+
+
+_PROVE_ATTEMPTED: set[tuple] = set()
+
+
+def _attempt_key(out_root, assembly, config, mesh_shape) -> tuple:
+    from .shape_key import bucket_key
+
+    return (
+        out_root, bucket_key(assembly, config),
+        json.dumps(variant_fingerprint(mesh_shape), sort_keys=True),
+    )
+
+
+def _mark_attempted(out_root, assembly, config, mesh_shape) -> bool:
+    """Record one consult of (root, bucket, variant); True if it was
+    already attempted this process (success or failure — a failed
+    bundle stays failed, re-warning every prove helps nobody)."""
+    key = _attempt_key(out_root, assembly, config, mesh_shape)
+    if key in _PROVE_ATTEMPTED:
+        return True
+    _PROVE_ATTEMPTED.add(key)
+    return False
+
+
+def maybe_load_for_prove(assembly, config, mesh=None) -> dict | None:
+    """prove()'s pre-trace consult: when BOOJUM_TPU_AOT_DIR is set, load
+    (and warm) the bundle for this bucket/variant ONCE per process.
+    No-op-cheap without the env var; a missing/stale bundle logs once
+    and lets the prove JIT (unless BOOJUM_TPU_AOT_REQUIRE)."""
+    if _BUILDING[0]:
+        # the build step's own capture prove must never pull a PREVIOUS
+        # bundle's entries into the redirected cache it is populating
+        return None
+    root = aot_dir()
+    if root is None:
+        return None
+    if mesh is not None and not _would_shard_map(mesh):
+        # the legacy GSPMD path partitions its own sequenced graphs —
+        # not the enumerated kernel set a bundle holds; nothing to load
+        return None
+    mesh_shape = _mesh_shape_list(mesh) if mesh is not None else None
+    if _attempt_key(root, assembly, config, mesh_shape) in _PROVE_ATTEMPTED:
+        # already consulted — by an earlier prove, or by a direct
+        # load_and_warm caller (bench.py / service warmer)
+        return None
+    try:
+        return load_and_warm(root, assembly, config, mesh_shape=mesh_shape)
+    except AotBundleError:
+        raise  # BOOJUM_TPU_AOT_REQUIRE: surface, don't JIT
+    except Exception as e:  # noqa: BLE001 — an unexpected loader bug
+        # must degrade this prove to plain JIT, not fail it
+        _log(f"aot: consult failed ({e!r}) — proving via JIT")
+        return None

@@ -428,6 +428,7 @@ def precompile(
     ledger: CompileLedger | None = None,
     lower_only: bool = False,
     mesh_shape=None,
+    specs=None,
 ) -> CompileLedger:
     """Lower + compile the whole kernel library, overlapping the backend
     compiles on a thread pool.
@@ -439,7 +440,9 @@ def precompile(
     abort the sweep: a kernel that fails to precompile simply compiles at
     first dispatch like before. With `lower_only`, skips the backend
     compile — used by tier-1 tests to validate the enumeration on CPU,
-    and still exercises every trace path."""
+    and still exercises every trace path. `specs` lets a caller that
+    already enumerated (the aot.py bundle builder exports the same list)
+    skip the second derivation."""
     from .shape_key import bucket_key
 
     if ledger is None:
@@ -447,8 +450,11 @@ def precompile(
     # every ledger entry of this sweep carries the shape-bucket key —
     # the SAME key the service admission queue groups requests by
     shape = bucket_key(assembly, config)
-    with _span("precompile_enumerate", shape=shape):
-        specs = enumerate_kernels(assembly, config, mesh_shape=mesh_shape)
+    if specs is None:
+        with _span("precompile_enumerate", shape=shape):
+            specs = enumerate_kernels(
+                assembly, config, mesh_shape=mesh_shape
+            )
     _metrics.count("precompile.kernels", len(specs))
 
     lowered = []

@@ -934,7 +934,15 @@ def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     hierarchical spans, metrics and Fiat–Shamir digest checkpoints and
     appends one ProveReport JSONL line to <path> (utils/report.py). A
     caller that already installed a FlightRecorder (bench.py labels its
-    reps) keeps ownership — no double emission."""
+    reps) keeps ownership — no double emission.
+
+    AOT artifacts: with BOOJUM_TPU_AOT_DIR=<dir> the prove consults the
+    artifact store (prover/aot.py) BEFORE tracing — once per process per
+    (shape bucket, variant) the pre-built executable bundle is installed
+    into the persistent cache and warmed, so a cold process pays
+    deserialization instead of XLA compilation. A missing/stale bundle
+    logs a warning and the prove JIT-compiles as before
+    (BOOJUM_TPU_AOT_REQUIRE=1 makes that a hard error)."""
     import os
 
     from ..utils import report as _report
@@ -961,11 +969,21 @@ def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
 
 
 def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:
+    import os
+
     from ..parallel.sharding import prover_mesh
 
     clock = _StageClock()
     _metrics.count("prover.proves")
     with _span("prove", trace_len=assembly.trace_len):
+        # AOT consult INSIDE the recorded region (flight recorder is
+        # installed by now), so aot.* counters/gauges and the
+        # aot_load/aot_warm spans land on this prove's report line;
+        # once per process per (bucket, variant) — no-op-cheap after
+        if os.environ.get("BOOJUM_TPU_AOT_DIR", "").strip():
+            from . import aot as _aot
+
+            _aot.maybe_load_for_prove(assembly, config, mesh)
         try:
             if mesh is not None:
                 with prover_mesh(mesh):

@@ -134,8 +134,22 @@ class ShapeBucket:
             f":pi{self.num_public_inputs}:{lk}:g{self.gates_fp}"
         )
 
+    @property
+    def fingerprint(self) -> str:
+        """Short stable digest of `key` for filesystem-safe naming
+        (the AOT bundle store prefixes every bundle directory with it,
+        so an operator can grep a bundle back to its shape bucket)."""
+        return key_fingerprint(self.key)
+
     def __str__(self) -> str:
         return self.key
+
+
+def key_fingerprint(key: str) -> str:
+    """12-hex blake2s of a bucket key — the ONE fs-safe short form of
+    "same shape" (prover/aot.py bundle dirs; anything else that needs a
+    compact per-bucket name should use this, not its own hash)."""
+    return hashlib.blake2s(key.encode(), digest_size=6).hexdigest()
 
 
 def _gates_fingerprint(gates) -> str:

@@ -130,7 +130,14 @@ class VariantWarmer:
     `precompile()` pushes it through the persistent cache on a thread
     pool. `mode` = "full" (lower + backend compile), "lower" (trace-only
     — the CPU-test posture: validates enumeration, skips the compile
-    bill), or "off"."""
+    bill), or "off".
+
+    AOT artifacts: in "full" mode with BOOJUM_TPU_AOT_DIR set, the
+    warmer first consults the artifact store (prover/aot.py) for the
+    bundle matching (bucket, placement variant) — a hit installs +
+    deserializes the pre-built executables (O(seconds)) instead of
+    compiling; only on a miss does the warm fall back to the
+    precompile sweep."""
 
     def __init__(self, mode: str = "full", max_workers: int = 8):
         if mode not in ("full", "lower", "off"):
@@ -157,15 +164,35 @@ class VariantWarmer:
         with _span(
             "service_warm_variant", shape=bucket.key, placement=placement.kind
         ):
-            precompile(
-                assembly, config,
-                max_workers=self.max_workers,
-                ledger=current_compile_ledger(),
-                lower_only=self.mode == "lower",
-                mesh_shape=mesh_shape,
-            )
+            aot_stats = None
+            if self.mode == "full":
+                from ..prover import aot as _aot
+
+                root = _aot.aot_dir()
+                if root is not None:
+                    aot_stats = _aot.load_and_warm(
+                        root, assembly, config, mesh_shape=mesh_shape,
+                        ledger=current_compile_ledger(),
+                    )
+            if aot_stats is not None and aot_stats.get("aborted"):
+                # systematic key mismatch: the serial warm bailed out —
+                # the parallel sweep recompiles (warmed kernels re-hit)
+                aot_stats = None
+            if aot_stats is None:
+                precompile(
+                    assembly, config,
+                    max_workers=self.max_workers,
+                    ledger=current_compile_ledger(),
+                    lower_only=self.mode == "lower",
+                    mesh_shape=mesh_shape,
+                )
         _log(
             f"service: warmed {placement.kind} variant of {bucket.key} "
-            f"in {time.perf_counter() - t0:.1f}s ({self.mode})"
+            f"in {time.perf_counter() - t0:.1f}s "
+            + (
+                f"(aot: {aot_stats.get('aot_hits', '?')} artifact hits)"
+                if aot_stats is not None
+                else f"({self.mode})"
+            )
         )
         return True

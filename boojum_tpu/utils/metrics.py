@@ -200,6 +200,30 @@ def count_service_cache(event: str, nbytes: int = 0):
         reg.gauge_add("service.cache.evicted_bytes", float(nbytes))
 
 
+def count_aot(event: str):
+    """Tally one AOT artifact-store event (prover/aot.py). The seam owns
+    the `aot.*` counter names so the artifact loader, the report
+    validator and the SLO summary can never disagree on them:
+      aot.hits / aot.misses            (warm pass, per kernel)
+      aot.builds / aot.bundles_loaded  (per bundle)
+      aot.bundle_misses / aot.stale_bundles / aot.corrupt_bundles
+      aot.corrupt_entries
+    """
+    reg = _REGISTRY
+    if reg is not None:
+        reg.count(f"aot.{event}")
+
+
+def gauge_aot_add(name: str, v: float):
+    """Accumulate an `aot.<name>` gauge (deserialize_s, load_s,
+    bundle_bytes — the artifact store's wall/size axis; the report
+    validator requires deserialize_s whenever aot hits/misses were
+    counted)."""
+    reg = _REGISTRY
+    if reg is not None:
+        reg.gauge_add(f"aot.{name}", float(v))
+
+
 def gauge_service(name: str, v: float):
     """Set a `service.<name>` gauge (queue depth, pinned bytes, occupancy
     — the proving service's per-request SLO axis)."""

@@ -182,12 +182,17 @@ class CompileLedger:
     # -- explicit source (precompile.py / service warm-up) -----------------
     def record(self, name: str, trace_s: float, compile_s: float,
                cache_hit: bool | None = None, error: str | None = None,
-               shape_key: str | None = None):
+               shape_key: str | None = None, aot_hit: bool | None = None):
         """`shape_key` is the canonical shape-bucket key of the
         (assembly, config) pair this kernel belongs to
         (prover/shape_key.py) — the SAME key the service admission queue
         buckets on, so a compile-bill regression is attributable to the
-        bucket that paid it."""
+        bucket that paid it. `aot_hit` (prover/aot.py's warm pass) marks
+        whether this kernel came back as an AOT-artifact
+        DESERIALIZATION (True) or escaped to a real compile (False) —
+        the summary splits `aot_hits`/`aot_misses`/`aot_deserialize_s`
+        from ordinary compiles so a warm-up wall is attributable to the
+        right bill."""
         with self._lock:
             entry = {
                 "name": name,
@@ -198,6 +203,8 @@ class CompileLedger:
             }
             if shape_key is not None:
                 entry["shape"] = shape_key
+            if aot_hit is not None:
+                entry["aot_hit"] = bool(aot_hit)
             if error is not None:
                 entry["error"] = error
             self.entries.append(entry)
@@ -259,9 +266,21 @@ class CompileLedger:
             entries + dispatch, key=lambda e: e["compile_s"], default=None
         )
         shapes = sorted({e["shape"] for e in entries if e.get("shape")})
+        aot_entries = [e for e in entries if "aot_hit" in e]
+        aot_hits = sum(1 for e in aot_entries if e["aot_hit"])
         return {
             "num_kernels": len(entries),
             "shapes": shapes,
+            # AOT artifact accounting (prover/aot.py warm pass): kernels
+            # satisfied by executable DESERIALIZATION vs ones that
+            # escaped to a compile, and the total deserialize wall — the
+            # field a warm-up line's wall is attributed to when a bundle
+            # served it
+            "aot_hits": aot_hits,
+            "aot_misses": len(aot_entries) - aot_hits,
+            "aot_deserialize_s": round(
+                sum(e["compile_s"] for e in aot_entries if e["aot_hit"]), 3
+            ),
             "precompile_total_s": round(compile_total, 3),
             "num_dispatch_compiles": len(dispatch),
             "dispatch_compile_total_s": round(

@@ -383,6 +383,54 @@ def validate_report(report: dict) -> list[str]:
                 "service.cache.evictions counted but "
                 "service.cache.evicted_bytes gauge is missing/zero"
             )
+        # aot.* — the AOT artifact store's axis (prover/aot.py). Every
+        # value must be a finite non-negative number; warmed kernels
+        # (hits+misses > 0) must carry the deserialize-time gauge; and a
+        # line claiming every kernel was an artifact hit while its
+        # compile ledger still counted cache misses (real compiles) is
+        # LYING about its warm-up bill and must fail the gate.
+        for src in (counters, gauges):
+            for k, v in src.items():
+                if not k.startswith("aot."):
+                    continue
+                if not isinstance(v, (int, float)) or v != v or v < 0:
+                    problems.append(f"aot metric {k}: invalid value {v!r}")
+        aot_hits = _num(counters.get("aot.hits", 0))
+        aot_misses = _num(counters.get("aot.misses", 0))
+        if (aot_hits + aot_misses) > 0 and "aot.deserialize_s" not in gauges:
+            problems.append(
+                "aot.hits/aot.misses counted but aot.deserialize_s "
+                "gauge missing"
+            )
+        # the aot_hit-vs-compile cross-check compares LEDGER fields with
+        # LEDGER fields (both process-cumulative): a line whose ledger
+        # claims every warmed kernel deserialized from an artifact
+        # (aot_hits > 0, aot_misses == 0) while the same ledger counted
+        # persistent-cache misses means real compiles escaped the
+        # artifact store — the zero-compile claim is false
+        ledger = report.get("compile_ledger")
+        if isinstance(ledger, dict):
+            ledger_hits = _num(ledger.get("aot_hits", 0))
+            ledger_misses = _num(ledger.get("aot_misses", 0))
+            num_kernels = _num(ledger.get("num_kernels", 0))
+            # fires only when the ledger claims FULL aot coverage —
+            # every recorded kernel an artifact hit. A mixed-bucket
+            # process (bucket A bundled, bucket B precompiled normally)
+            # has num_kernels > aot_hits and is a supported state, not
+            # a lie.
+            if (
+                ledger_hits > 0
+                and ledger_misses == 0
+                and ledger_hits == num_kernels
+            ):
+                compiles = _num(ledger.get("cache_misses", 0))
+                if compiles > 0:
+                    problems.append(
+                        f"prove claims all-aot_hit kernels but the "
+                        f"compile ledger records {int(compiles)} cache "
+                        f"misses (real compiles escaped the artifact "
+                        f"store)"
+                    )
     # per-request SLO record (proving-service lines): the record the
     # --slo summary and dashboards key on — a request line missing its
     # queue latency or placement is unusable for SLO accounting and
@@ -566,6 +614,20 @@ def slo_summary(reports: list[dict]) -> dict:
     def r6(v):
         return None if v is None else round(v, 6)
 
+    # artifact-hit rate over the artifact's lines: every aot.hits /
+    # aot.misses counter recorded anywhere in the stream (service warm
+    # phases, bench warm-ups) — the deployment-health axis the AOT
+    # bundle store adds
+    aot_hits = aot_misses = 0
+    for r in reports:
+        c = (r.get("metrics") or {}).get("counters") or {}
+        if isinstance(c, dict):
+            h, m = c.get("aot.hits", 0), c.get("aot.misses", 0)
+            # skip malformed values like every other field here — one
+            # junk line must not kill the whole --slo summary
+            aot_hits += h if isinstance(h, (int, float)) else 0
+            aot_misses += m if isinstance(m, (int, float)) else 0
+
     return {
         "requests": len(reqs),
         "served": len(ok),
@@ -585,6 +647,12 @@ def slo_summary(reports: list[dict]) -> dict:
         "cache_hit_rate": (
             round(cache_hits / len(reqs), 4) if reqs else None
         ),
+        "aot_kernels_warmed": aot_hits + aot_misses,
+        "aot_hit_rate": (
+            round(aot_hits / (aot_hits + aot_misses), 4)
+            if (aot_hits + aot_misses)
+            else None
+        ),
     }
 
 
@@ -599,6 +667,11 @@ def render_slo(summary: dict) -> str:
         f"  proofs/sec    {summary['proofs_per_sec']}",
         f"  cache hit rate {summary['cache_hit_rate']}",
     ]
+    if summary.get("aot_kernels_warmed"):
+        lines.append(
+            f"  aot artifacts {summary['aot_hit_rate']} hit rate over "
+            f"{summary['aot_kernels_warmed']} warmed kernels"
+        )
     if summary.get("placements"):
         lines.append(
             "  placements    "
@@ -703,6 +776,15 @@ def render_report(report: dict, top: int = 10) -> str:
             f"precompile {ledger.get('precompile_total_s')}s, "
             f"{ledger.get('num_dispatch_compiles')} dispatch compiles"
         )
+        hits = ledger.get("aot_hits") or 0
+        misses = ledger.get("aot_misses") or 0
+        if hits + misses:
+            lines.append(
+                f"  aot artifacts: {hits}/{hits + misses} kernels "
+                f"deserialized "
+                f"({100 * hits / (hits + misses):.1f}% hit rate), "
+                f"deserialize {ledger.get('aot_deserialize_s')}s"
+            )
     return "\n".join(lines)
 
 

@@ -27,6 +27,16 @@ def pytest_configure(config):
         "markers", "slow: excluded from the tier-1 budget (-m 'not slow')"
     )
 
+
+def pytest_collection_modifyitems(items):
+    # run the AOT artifact tests LAST (stable sort): their subprocess
+    # bundle build pays real XLA compiles into a fresh bundle dir every
+    # run (the whole point is an isolated cache), which the repo-local
+    # persistent cache cannot amortize — if the tier-1 wall-clock budget
+    # dies mid-suite, that fixed cost must burn the END of the budget,
+    # not starve the alphabetically-later test files
+    items.sort(key=lambda it: it.fspath.basename == "test_aot.py")
+
 # The axon sitecustomize (PYTHONPATH) registers a remote-TPU PJRT plugin whose
 # backend init blocks even under JAX_PLATFORMS=cpu; deregister it outright so
 # unit tests run on the local 8-device virtual CPU platform.

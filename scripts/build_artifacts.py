@@ -1,0 +1,159 @@
+"""Build an AOT executable artifact bundle (ISSUE 8 build step).
+
+Compiles the full dispatch surface of one circuit/config — the
+enumerated kernel library, the setup pipeline and one capture prove —
+with the persistent compilation cache redirected into a deployment
+bundle under --out (default: $BOOJUM_TPU_AOT_DIR or ./aot_artifacts),
+plus a jax.export StableHLO artifact per exportable kernel and a
+manifest with integrity hashes. After this, any process on the SAME
+(jax, jaxlib, backend, device kind/count, host CPU) stack that sets
+BOOJUM_TPU_AOT_DIR to the bundle root proves with ZERO XLA compiles:
+`prove()`, the service VariantWarmer and bench.py all consult the
+store before tracing.
+
+Usage:
+  python scripts/build_artifacts.py [--circuit sha256|fma]
+      [--sha-bytes N] [--log-n N] [--lde N] [--queries N]
+      [--out DIR] [--mesh C,R] [--workers N] [--no-prove]
+
+Runs on whatever JAX_PLATFORMS the environment pins — build on the
+deployment platform (the artifacts are platform-fingerprinted and a
+mismatched consumer falls back to JIT with a warning). Equivalent
+one-shot for the bench circuit: `python bench.py --build-artifacts`.
+
+Prints one JSON summary line: bundle dir, kernel/export/cache-entry
+counts, bytes, build wall and the compile-ledger summary.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="build_artifacts.py",
+        description="Build an AOT executable artifact bundle",
+    )
+    ap.add_argument(
+        "--circuit", default="sha256", choices=("sha256", "fma"),
+        help="which bench circuit to build for (default sha256)",
+    )
+    ap.add_argument(
+        "--sha-bytes", type=int,
+        default=int(os.environ.get("BENCH_SHA_BYTES", "8192")),
+        help="sha256 message size (default $BENCH_SHA_BYTES or 8192)",
+    )
+    ap.add_argument(
+        "--log-n", type=int,
+        default=int(os.environ.get("BENCH_LOG_N", "10")),
+        help="fma-mode trace log2 size (default $BENCH_LOG_N or 10)",
+    )
+    ap.add_argument(
+        "--lde", type=int, default=None,
+        help="FRI commit rate (default: bench's per-circuit default)",
+    )
+    ap.add_argument(
+        "--queries", type=int,
+        default=int(os.environ.get("BENCH_QUERIES", "50")),
+        help="FRI query count (default $BENCH_QUERIES or 50)",
+    )
+    ap.add_argument(
+        "--cap", type=int, default=16,
+        help="Merkle tree cap size (default 16, the bench config)",
+    )
+    ap.add_argument(
+        "--final-degree", type=int, default=16,
+        help="FRI final degree (default 16, the bench config)",
+    )
+    ap.add_argument(
+        "--out", default=None,
+        help="bundle root (default $BOOJUM_TPU_AOT_DIR or "
+             "./aot_artifacts)",
+    )
+    ap.add_argument(
+        "--mesh", default=None, metavar="C,R",
+        help="build the shard_map mesh variant for a ('col','row') "
+             "mesh of this shape (default: meshless)",
+    )
+    ap.add_argument(
+        "--workers", type=int,
+        default=int(os.environ.get("BENCH_PRECOMPILE_WORKERS", "8")),
+        help="precompile thread-pool width (default 8)",
+    )
+    ap.add_argument(
+        "--no-prove", action="store_true",
+        help="skip the capture setup+prove (bundle covers only the "
+             "enumerated kernel library — setup/query graphs will JIT)",
+    )
+    args = ap.parse_args(argv)
+
+    # bench.py owns the circuit builders AND the fingerprint-salted
+    # cache / compile-ledger process setup — reuse both
+    import bench  # noqa: E402  (repo root on sys.path above)
+
+    from boojum_tpu.prover import ProofConfig
+    from boojum_tpu.prover.aot import build_bundle
+    from boojum_tpu.utils.profiling import current_compile_ledger
+
+    lde = args.lde
+    if lde is None:
+        lde = 8 if args.circuit == "sha256" else 4
+    config = ProofConfig(
+        fri_lde_factor=lde,
+        merkle_tree_cap_size=args.cap,
+        num_queries=args.queries,
+        pow_bits=0,
+        fri_final_degree=args.final_degree,
+    )
+    if args.circuit == "sha256":
+        cs = bench.build_sha256(args.sha_bytes)
+    else:
+        cs = bench.build_fma(args.log_n)
+    asm = cs.into_assembly()
+    print(f"trace_len={asm.trace_len}", file=sys.stderr, flush=True)
+
+    mesh_shape = None
+    if args.mesh:
+        c, r = args.mesh.split(",")
+        mesh_shape = (int(c), int(r))
+
+    out_root = args.out or os.environ.get(
+        "BOOJUM_TPU_AOT_DIR", ""
+    ).strip() or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "aot_artifacts",
+    )
+
+    ledger = current_compile_ledger()
+    manifest = build_bundle(
+        asm, config, out_root,
+        mesh_shape=mesh_shape,
+        ledger=ledger,
+        max_workers=args.workers,
+        include_prove=not args.no_prove,
+    )
+    line = {
+        "status": "ok",
+        "bundle": manifest["dir"],
+        "bucket": manifest["bucket"],
+        "variant": manifest["variant"],
+        "num_kernels": manifest["num_kernels"],
+        "num_exports": manifest["num_exports"],
+        "num_cache_entries": len(manifest["cache_entries"]),
+        "cache_bytes": manifest["cache_bytes"],
+        "build_wall_s": manifest["build_wall_s"],
+    }
+    if ledger is not None:
+        line["compile_ledger"] = ledger.summary()
+    print(json.dumps(line), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -23,12 +23,18 @@ Usage:
       the artifact (the cheap post-bench gate) — including the proving
       service's per-request SLO records (a request line missing its
       queue latency or placement, or carrying malformed service.*
-      gauges, fails). Exits 1 on any problem.
+      gauges, fails) and the AOT artifact-store gauges (malformed
+      aot.* values, warmed kernels without the aot.deserialize_s
+      gauge, or a line whose ledger claims every kernel was an
+      `aot_hit` while also counting cache misses — i.e. real compiles
+      escaped the artifact store — all fail). Exits 1 on any problem.
 
   python scripts/prove_report.py --slo <report.jsonl>
       Aggregate the per-request SLO records of a proving-service
       artifact: p50/p95 queue latency and prove wall, proofs/sec over
-      the serving span, per-placement/priority counts, cache hit rate.
+      the serving span, per-placement/priority counts, cache hit rate,
+      and the AOT artifact hit rate over every warmed kernel in the
+      stream.
 
 Reports come from BOOJUM_TPU_REPORT=<path> (any prove), bench.py (labeled
 warm-up/rep lines), scripts/multihost_worker.py (per-host files) or
