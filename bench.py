@@ -485,6 +485,18 @@ def _emit(status):
                 out["span_tree"] = tree
         if _STATE["ntt_eps"] is not None:
             out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
+        # live-telemetry time series (queue-less in bench, but device
+        # memory + live-buffer census over the whole run): the same
+        # `telemetry` record the service's report lines carry, so a
+        # watchdog line shows WHEN memory climbed, not just the peak
+        try:
+            from boojum_tpu.utils import telemetry as _telemetry
+
+            sampler = _telemetry.current_sampler()
+            if sampler is not None:
+                out["telemetry"] = sampler.snapshot()
+        except Exception:
+            pass
         # the compile-ledger summary rides on EVERY line (including the
         # watchdog's) so a timeout is diagnosable from the JSON alone:
         # which graph compiled longest, how much the cache saved, whether
@@ -633,6 +645,19 @@ def main():
     bench_rec = _spans.SpanRecorder(sync=False)
     _LIVE_REC["bench"] = bench_rec
     _spans.install_recorder(bench_rec)
+
+    # bench-lifetime telemetry sampler (BOOJUM_TPU_TELEMETRY_INTERVAL
+    # cadence, =0 is rejected by the parser — there is no off switch
+    # because a 1 Hz census costs microseconds): every ProveReport line
+    # and the final bench JSON line carry its time series
+    try:
+        from boojum_tpu.utils import telemetry as _telemetry
+
+        sampler = _telemetry.TelemetrySampler()
+        _telemetry.install_sampler(sampler)
+        sampler.start()
+    except Exception as e:
+        _log(f"telemetry sampler failed to start: {e!r}")
 
     circuit = os.environ.get("BENCH_CIRCUIT", "sha256")
     reps = int(os.environ.get("BENCH_REPS", "3"))

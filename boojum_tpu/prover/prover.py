@@ -942,30 +942,47 @@ def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     into the persistent cache and warmed, so a cold process pays
     deserialization instead of XLA compilation. A missing/stale bundle
     logs a warning and the prove JIT-compiles as before
-    (BOOJUM_TPU_AOT_REQUIRE=1 makes that a hard error)."""
+    (BOOJUM_TPU_AOT_REQUIRE=1 makes that a hard error).
+
+    On-demand device profiles: BOOJUM_TPU_XPROF=<dir>[:N] arms a
+    process-wide budget — the next N proves each capture a jax.profiler
+    trace into a fresh subdirectory, recorded as the report line's
+    `trace` record (and skipped silently when a caller — the proving
+    service honoring a request's capture_trace flag — already holds the
+    capture)."""
     import os
 
+    from ..utils import profiling as _prof
     from ..utils import report as _report
 
+    label = f"prove_n{assembly.trace_len}"
     path = os.environ.get("BOOJUM_TPU_REPORT")
-    if path and _report.current_flight_recorder() is None:
-        with _report.flight_recording(
-            label=f"prove_n{assembly.trace_len}"
-        ) as rec:
-            try:
-                return _prove_entry(assembly, setup, config, mesh)
-            finally:
-                # emit even when the prove raised — the partial span tree
-                # (with its error field) and the checkpoints up to the
-                # failure are exactly what a post-mortem needs
+    with _prof.maybe_trace_capture(label) as trace_dir:
+        if trace_dir:
+            # attribute the capture to whoever is recording this prove
+            # (a caller-owned flight recorder, or the one below)
+            rec_owner = _report.current_flight_recorder()
+            if rec_owner is not None:
+                rec_owner.trace_dir = trace_dir
+        if path and _report.current_flight_recorder() is None:
+            with _report.flight_recording(label=label) as rec:
+                rec.trace_dir = trace_dir
                 try:
-                    _report.append_jsonl(path, _report.build_report(rec))
-                except Exception as e:  # noqa: BLE001 — the recorder must
-                    # never turn a successful prove into a crash
-                    from ..utils.profiling import log
+                    return _prove_entry(assembly, setup, config, mesh)
+                finally:
+                    # emit even when the prove raised — the partial span
+                    # tree (with its error field) and the checkpoints up
+                    # to the failure are exactly what a post-mortem needs
+                    try:
+                        _report.append_jsonl(
+                            path, _report.build_report(rec)
+                        )
+                    except Exception as e:  # noqa: BLE001 — the recorder
+                        # must never turn a successful prove into a crash
+                        from ..utils.profiling import log
 
-                    log(f"ProveReport write to {path!r} failed: {e!r}")
-    return _prove_entry(assembly, setup, config, mesh)
+                        log(f"ProveReport write to {path!r} failed: {e!r}")
+        return _prove_entry(assembly, setup, config, mesh)
 
 
 def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:

@@ -11,12 +11,16 @@ Public surface:
   (cache.py).
 - `choose_placement` / `Placement` / `SHARD_PARALLEL` / `PROOF_PARALLEL`
   — the scheduler (scheduler.py).
+- `MetricsPlane` — the stdlib HTTP telemetry endpoint
+  (http_metrics.py: /metrics Prometheus text, /healthz, /slo), started
+  by the worker loop when `ServiceConfig.metrics_port` is set.
 
 Driver CLI: `scripts/prove_service.py`; bench integration:
 `bench.py --service`.
 """
 
 from .cache import DeviceCacheManager
+from .http_metrics import MetricsPlane
 from .queue import LANES, AdmissionQueue, QueueFullError
 from .scheduler import (
     PROOF_PARALLEL,
@@ -30,6 +34,7 @@ __all__ = [
     "AdmissionQueue",
     "DeviceCacheManager",
     "LANES",
+    "MetricsPlane",
     "Placement",
     "PROOF_PARALLEL",
     "ProveRequest",

@@ -1,0 +1,193 @@
+"""Stdlib-only HTTP telemetry plane for the proving service.
+
+Three read-only endpoints over `http.server.ThreadingHTTPServer` (no
+third-party dependency — the container may not have a metrics stack,
+and the endpoint must cost nothing when unused):
+
+  /metrics   Prometheus text exposition (version 0.0.4) of the
+             telemetry sampler's registry — `telemetry.*` time-series
+             gauges (device memory, live buffers, queue depth, lane
+             occupancy, in-flight count) plus any counters — with
+             metric names sanitized to `boojum_tpu_*`.
+  /healthz   liveness JSON: status, uptime, sampler tick count, plus
+             whatever the owner's health callback reports (served /
+             failed / queue depth for the proving service).
+  /slo       the per-request SLO aggregation of `report.slo_summary`
+             over the service's report artifact — the same numbers
+             `scripts/prove_report.py --slo` prints, live.
+
+The server binds 127.0.0.1 by default (scrape-agent posture; an
+operator who wants it exposed passes host="0.0.0.0" explicitly) and
+port 0 picks a free port — `start()` returns the bound one. Request
+handling is threaded so a slow scrape never blocks the worker loop, and
+every handler is exception-safe: a probe must never take the prover
+down.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str, prefix: str = "boojum_tpu") -> str:
+    return _NAME_RE.sub("_", f"{prefix}_{name}")
+
+
+def prometheus_text(metrics: dict, prefix: str = "boojum_tpu") -> str:
+    """Render a {counters: {...}, gauges: {...}} metrics dict (the
+    MetricsRegistry.to_dict shape) as Prometheus text exposition."""
+    lines: list[str] = []
+    for kind, prom_type in (("counters", "counter"), ("gauges", "gauge")):
+        for name, value in sorted((metrics.get(kind) or {}).items()):
+            if not isinstance(value, (int, float)) or value != value:
+                continue
+            pname = _prom_name(name, prefix)
+            lines.append(f"# TYPE {pname} {prom_type}")
+            lines.append(f"{pname} {value}")
+    return "\n".join(lines) + "\n" if lines else "\n"
+
+
+class MetricsPlane:
+    """One HTTP server exposing a telemetry sampler + owner callbacks.
+
+    `sampler` provides the registry behind /metrics; `health_fn` and
+    `slo_fn` are optional zero-arg callables returning JSON-able dicts
+    for /healthz and /slo. All endpoints stay up (with partial data)
+    when a callback raises — observability must degrade, not crash."""
+
+    def __init__(
+        self,
+        sampler,
+        health_fn=None,
+        slo_fn=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ):
+        self.sampler = sampler
+        self.health_fn = health_fn
+        self.slo_fn = slo_fn
+        self.host = host
+        self.port = int(port)
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+        self._t0 = None
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        """Bind + serve on a daemon thread; returns the bound port."""
+        import time
+
+        if self._server is not None:
+            return self.port
+        plane = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802 — http.server API
+                try:
+                    path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                    if path == "/metrics":
+                        body = plane.render_metrics().encode()
+                        self._send(
+                            200, body,
+                            "text/plain; version=0.0.4; charset=utf-8",
+                        )
+                    elif path == "/healthz":
+                        self._send(
+                            200,
+                            json.dumps(plane.render_health()).encode(),
+                            "application/json",
+                        )
+                    elif path == "/slo":
+                        self._send(
+                            200,
+                            json.dumps(plane.render_slo()).encode(),
+                            "application/json",
+                        )
+                    else:
+                        self._send(404, b'{"error":"not found"}',
+                                   "application/json")
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # noqa: BLE001 — a probe must
+                    # never crash the serving process
+                    try:
+                        self._send(
+                            500,
+                            json.dumps({"error": repr(e)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
+
+        self._server = ThreadingHTTPServer((self.host, self.port), _Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._t0 = time.perf_counter()
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="boojum-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        srv = self._server
+        if srv is None:
+            return
+        self._server = None
+        srv.shutdown()
+        srv.server_close()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+        self._thread = None
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    # ---- endpoint bodies (pure, unit-testable without sockets) -----------
+    def render_metrics(self) -> str:
+        return prometheus_text(self.sampler.registry.to_dict())
+
+    def render_health(self) -> dict:
+        import time
+
+        out = {
+            "status": "ok",
+            "uptime_s": (
+                round(time.perf_counter() - self._t0, 3)
+                if self._t0 is not None else 0.0
+            ),
+            "telemetry_ticks": self.sampler.ticks,
+            "telemetry_interval_s": self.sampler.interval_s,
+        }
+        if self.health_fn is not None:
+            try:
+                out.update(self.health_fn())
+            except Exception as e:
+                out["health_fn_error"] = repr(e)
+        return out
+
+    def render_slo(self) -> dict:
+        if self.slo_fn is None:
+            return {"requests": 0, "note": "no SLO source configured"}
+        try:
+            return self.slo_fn()
+        except Exception as e:
+            return {"requests": 0, "error": repr(e)}

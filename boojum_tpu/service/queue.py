@@ -139,3 +139,14 @@ class AdmissionQueue:
                 for key, reqs in buckets.items():
                     out[key] = out.get(key, 0) + len(reqs)
             return out
+
+    def lane_depths(self) -> dict[str, int]:
+        """lane -> queued request count — the telemetry sampler's lane
+        occupancy axis (utils/telemetry.py): a bulk lane filling while
+        interactive stays drained is healthy, the reverse is an SLO
+        fire."""
+        with self._lock:
+            return {
+                lane: sum(len(reqs) for reqs in buckets.values())
+                for lane, buckets in self._lanes.items()
+            }

@@ -20,6 +20,11 @@ The decision inputs are exactly what the admission queue exposes:
   parallelize at small n, and dispatching the `_sm` kernel variants
   would compile a second library for no win.
 
+Packing is UNCONDITIONAL on the recording state: the flight recorder's
+collectors are contextvars-scoped (ISSUE 9), so every packed request
+records its own spans/metrics/checkpoints concurrently — the historical
+"max_inflight > 1 requires recording off" restriction is gone.
+
 `warm_for_placement` then warms exactly the kernel-library variant the
 chosen placement dispatches (`precompile.enumerate_kernels(mesh_shape=)`
 enumerates only the dispatched set), so admission-time compile work

@@ -26,13 +26,23 @@ the transcript.
 
 Concurrency contract: requests are served one batch at a time by ONE
 worker loop (the mesh is one resource). Proof-parallel packing runs up
-to `max_inflight` same-bucket requests concurrently on distinct chips
-— but only when flight recording is OFF, because the recorder's
-span/metrics/checkpoint collectors are process-global and interleaved
-recording would corrupt the per-request checkpoint streams; with
-recording on, packing degrades to sequential (the SLO record notes
-`packed: 1`). Cross-host proof-parallelism composes through
+to `max_inflight` same-bucket requests concurrently on distinct chips,
+RECORDING INCLUDED: each packed request binds its own contextvars-scoped
+flight recorder (utils/report.flight_recording(scoped=True)) on its pool
+thread, so every request — packed or sequential — gets a complete
+ProveReport line with its own spans, counters and digest-checkpoint
+stream, and interleaved recording can no longer corrupt a neighbor's.
+Cross-host proof-parallelism composes through
 `parallel.multihost.distribute_proofs` (see scripts/multihost_worker).
+
+Live telemetry plane (ISSUE 9): a background sampler
+(utils/telemetry.py) snapshots device memory, the live-buffer census,
+queue depth / lane occupancy and the in-flight count on a fixed cadence,
+and `run_worker` exposes its registry over a stdlib HTTP endpoint
+(service/http_metrics.py: /metrics Prometheus text, /healthz, /slo)
+when `metrics_port` is configured. Per-request `capture_trace=True`
+(or an armed BOOJUM_TPU_XPROF budget) records a jax.profiler trace
+attributable to the request via the report line's `trace` record.
 """
 
 from __future__ import annotations
@@ -87,9 +97,15 @@ class ServiceConfig:
     shard_threshold_rows: int | None = None
     report_path: str | None = None  # default: BOOJUM_TPU_REPORT
     mesh: object | str | None = "auto"  # "auto" | Mesh | None (meshless)
+    # live telemetry plane: None = no HTTP endpoint; 0 = any free port
+    # (bound port comes back from start_telemetry / the worker loop log)
+    metrics_port: int | None = None   # BOOJUM_TPU_SERVICE_METRICS_PORT
+    # sampler cadence; None = BOOJUM_TPU_TELEMETRY_INTERVAL (default 1s)
+    telemetry_interval_s: float | None = None
 
     @classmethod
     def from_env(cls) -> "ServiceConfig":
+        port = _env_int("BOOJUM_TPU_SERVICE_METRICS_PORT", -1)
         return cls(
             queue_capacity=_env_int("BOOJUM_TPU_SERVICE_QUEUE_CAP", 64),
             cache_bytes=_env_int(
@@ -99,6 +115,7 @@ class ServiceConfig:
             precompile=os.environ.get(
                 "BOOJUM_TPU_SERVICE_PRECOMPILE", ""
             ).strip().lower() or "full",
+            metrics_port=None if port < 0 else port,
         )
 
 
@@ -113,6 +130,8 @@ class ProveRequest:
     id: str
     priority: str = "batch"
     tenant: str = "default"
+    capture_trace: bool = False    # record a jax.profiler trace of the
+    #                                prove (report line carries the dir)
     bucket: object = None          # ShapeBucket, stamped at submit
     bucket_key: str = ""
     submit_ts: float = 0.0
@@ -168,6 +187,30 @@ class ProvingService:
         )
         self._ids = itertools.count(1)
         self._serve_lock = threading.Lock()
+        # packed requests append report lines from pool threads; one
+        # writer at a time keeps the JSONL artifact line-atomic
+        self._report_lock = threading.Lock()
+        self._inflight = 0
+        # live telemetry plane: sampler built eagerly (providers close
+        # over the queue/stats), started by run_worker/start_telemetry
+        from ..utils import telemetry as _telemetry
+
+        self.sampler = _telemetry.TelemetrySampler(
+            interval_s=self.config.telemetry_interval_s
+        )
+        self.sampler.add_provider("service.queue.depth", self.queue.depth)
+        self.sampler.add_provider(
+            "service.queue.lane", self.queue.lane_depths
+        )
+        self.sampler.add_provider(
+            "service.inflight", lambda: self._inflight
+        )
+        self.sampler.add_provider(
+            "service.cache.pinned_bytes",
+            lambda: self.cache.stats().get("pinned_bytes", 0),
+        )
+        self.metrics_plane = None
+        self._owns_sampler_install = False
         # packed proof-parallel mode mutates these from pool threads
         self._stats_lock = threading.Lock()
         self.stats = {
@@ -188,10 +231,14 @@ class ProvingService:
         priority: str = "batch",
         tenant: str = "default",
         request_id: str | None = None,
+        capture_trace: bool = False,
     ) -> ProveRequest:
         """Admit one job (raises QueueFullError at the queue bound —
         the caller's backpressure signal). Shape bucketing happens here,
-        with the SAME key the precompile pass and compile ledger use."""
+        with the SAME key the precompile pass and compile ledger use.
+        `capture_trace=True` records a jax.profiler trace of this
+        request's prove (profiling.maybe_trace_capture); the trace dir
+        rides the request's report line and SLO record."""
         req = ProveRequest(
             assembly=assembly,
             setup=setup,
@@ -199,6 +246,7 @@ class ProvingService:
             id=request_id or f"req-{next(self._ids):04d}",
             priority=priority,
             tenant=tenant,
+            capture_trace=capture_trace,
         )
         req.bucket = shape_bucket(assembly, config)
         req.bucket_key = req.bucket.key
@@ -225,22 +273,137 @@ class ProvingService:
     ) -> dict:
         """The worker loop: drain the queue until empty (idle_wait_s=0)
         or until `stop` is set (a serving daemon passes idle_wait_s > 0
-        to block for new work). Returns the service stats summary."""
+        to block for new work). Returns the service stats summary.
+
+        Starts the live telemetry plane for the loop's lifetime: the
+        background sampler always runs (its samples ride every report
+        line as the `telemetry` record), and with `metrics_port`
+        configured the HTTP endpoint serves /metrics, /healthz and /slo
+        while the loop drains. Components the caller already started
+        (start_telemetry) are left running on exit; anything THIS call
+        started — including an endpoint bound over a caller-started
+        sampler — is stopped (start_telemetry is idempotent per
+        component, ownership is tracked per component too)."""
+        owns_sampler = not self.sampler.running()
+        had_plane = self.metrics_plane is not None
+        self.start_telemetry(self.config.metrics_port)
         t0 = time.perf_counter()
-        while stop is None or not stop.is_set():
-            served = self.process_once()
-            if served:
-                continue
-            if idle_wait_s <= 0:
-                break
-            self.queue.wait_nonempty(timeout=idle_wait_s)
-            if (
-                not self.queue.depth()
-                and stop is not None
-                and stop.is_set()
-            ):
-                break
-        return self.summary(wall_s=time.perf_counter() - t0)
+        try:
+            while stop is None or not stop.is_set():
+                served = self.process_once()
+                if served:
+                    continue
+                if idle_wait_s <= 0:
+                    break
+                self.queue.wait_nonempty(timeout=idle_wait_s)
+                if (
+                    not self.queue.depth()
+                    and stop is not None
+                    and stop.is_set()
+                ):
+                    break
+            return self.summary(wall_s=time.perf_counter() - t0)
+        finally:
+            if owns_sampler:
+                self.stop_telemetry()
+            elif not had_plane and self.metrics_plane is not None:
+                # the caller owned the sampler but WE bound the
+                # endpoint: release the port, keep their sampler
+                self.metrics_plane.stop()
+                self.metrics_plane = None
+
+    # ---- telemetry plane -------------------------------------------------
+    def start_telemetry(self, metrics_port: int | None = None) -> int | None:
+        """Start the background sampler (installed process-wide so
+        report lines pick up the `telemetry` record) and, with a port
+        (0 = any free port; None falls back to the config's
+        metrics_port), the HTTP metrics plane. Returns the bound port
+        or None. Idempotent; a bind failure logs and degrades to
+        sampler-only — observability must never take the prover down."""
+        from ..utils import telemetry as _telemetry
+
+        if metrics_port is None:
+            metrics_port = self.config.metrics_port
+        if not self.sampler.running():
+            # only adopt the process-wide slot if nobody else (a bench
+            # harness, another service) owns it
+            if _telemetry.current_sampler() is None:
+                _telemetry.install_sampler(self.sampler)
+                self._owns_sampler_install = True
+            self.sampler.start()
+        if metrics_port is not None and self.metrics_plane is None:
+            from .http_metrics import MetricsPlane
+
+            plane = MetricsPlane(
+                self.sampler,
+                health_fn=self._telemetry_health,
+                slo_fn=self._telemetry_slo,
+                port=metrics_port,
+            )
+            try:
+                port = plane.start()
+            except Exception as e:  # noqa: BLE001 — e.g. EADDRINUSE;
+                # leave metrics_plane None so a later call can retry
+                _log(
+                    f"service: telemetry endpoint failed to bind "
+                    f":{metrics_port}: {e!r} (sampler stays up)"
+                )
+                return None
+            self.metrics_plane = plane
+            _log(
+                f"service: telemetry plane up on :{port} "
+                f"(/metrics /healthz /slo)"
+            )
+            return port
+        return (
+            self.metrics_plane.port if self.metrics_plane is not None
+            else None
+        )
+
+    def stop_telemetry(self):
+        """Stop the sampler + HTTP plane (idempotent)."""
+        from ..utils import telemetry as _telemetry
+
+        if self.metrics_plane is not None:
+            self.metrics_plane.stop()
+            self.metrics_plane = None
+        self.sampler.stop()
+        if self._owns_sampler_install:
+            if _telemetry.current_sampler() is self.sampler:
+                _telemetry.install_sampler(None)
+            self._owns_sampler_install = False
+
+    def _telemetry_health(self) -> dict:
+        with self._stats_lock:
+            served = self.stats["served"]
+            failed = self.stats["failed"]
+            inflight = self._inflight
+        return {
+            "served": served,
+            "failed": failed,
+            "inflight": inflight,
+            "queue_depth": self.queue.depth(),
+            "queue_rejects": self.queue.rejects,
+        }
+
+    def _telemetry_slo(self) -> dict:
+        """The /slo endpoint body: report.slo_summary over this
+        service's report artifact (live view of what
+        `prove_report.py --slo` prints post-hoc). Memoized on the
+        artifact's (size, mtime): a scrape agent polling at 1 Hz must
+        not re-parse an ever-growing JSONL file on every probe."""
+        if not self.report_path or not os.path.exists(self.report_path):
+            return {"requests": 0, "note": "no report artifact yet"}
+        st = os.stat(self.report_path)
+        key = (st.st_size, st.st_mtime_ns)
+        cached = getattr(self, "_slo_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        summary = _report.slo_summary(
+            _report.load_reports(self.report_path)
+        )
+        self._slo_cache = (key, summary)
+        return summary
 
     # ---- internals -------------------------------------------------------
     def _serve_batch(self, batch: list) -> int:
@@ -264,23 +427,15 @@ class ProvingService:
                          placement)
         self.cache.warm_geometry(bucket)
 
-        recording = bool(self.report_path) or bool(
-            os.environ.get("BOOJUM_TPU_REPORT")
-        )
         pack = placement.pack if placement.kind == PROOF_PARALLEL else 1
         batch_t0 = time.perf_counter()
-        if pack > 1 and len(batch) > 1 and not recording:
+        if pack > 1 and len(batch) > 1:
+            # packing no longer cares about the recording state: each
+            # packed request scopes its own flight-recorder collectors
+            # via contextvars (_serve_one), so concurrent requests
+            # record complete, disjoint report lines
             served = self._serve_packed(batch, placement)
         else:
-            if pack > 1:
-                # recording ON: the flight recorder's collectors are
-                # process-global, so packing degrades to sequential to
-                # keep per-request checkpoint streams uncorrupted
-                placement = Placement(
-                    placement.kind, placement.mesh, pack=1,
-                    total_devices=placement.total_devices,
-                    reason=placement.reason + " (sequential: recording on)",
-                )
             served = 0
             for req in batch:
                 served += self._serve_one(req, placement)
@@ -295,25 +450,55 @@ class ProvingService:
         self.cache.after_request()
         return served
 
-    def _serve_one(self, req: ProveRequest, placement: Placement) -> int:
-        """Serve one request sequentially, with full flight recording
-        when a report path is configured."""
-        if not self.report_path:
-            return self._run_request(req, placement)
-        with _report.flight_recording(label=f"service:{req.id}") as rec:
+    def _serve_one(
+        self,
+        req: ProveRequest,
+        placement: Placement,
+        packed: int = 1,
+        device=None,
+    ) -> int:
+        """Serve one request with full flight recording when a report
+        path is configured. The collectors are contextvars-SCOPED, so
+        packed siblings running this concurrently on pool threads each
+        record their own complete line. (A bare BOOJUM_TPU_REPORT was
+        already resolved into self.report_path at construction —
+        __init__ via default_report_path — so the service's scoped path
+        owns recording and prove()'s process-global fallback never
+        fires under packing.)"""
+        path = self.report_path
+        if not path:
+            return self._run_request(req, placement, packed=packed,
+                                     device=device)
+        with _report.flight_recording(
+            label=f"service:{req.id}", scoped=True
+        ) as rec:
             try:
-                ok = self._run_request(req, placement)
+                ok = self._run_request(req, placement, packed=packed,
+                                       device=device)
             finally:
                 # the request record rides the ProveReport line even
                 # when the prove raised — a failed request's partial
                 # spans + SLO fields are the post-mortem
                 try:
-                    _report.append_jsonl(
-                        self.report_path,
-                        _report.build_report(
-                            rec, extra={"request": dict(req.slo)}
-                        ),
+                    line = _report.build_report(
+                        rec, extra={"request": dict(req.slo)}
                     )
+                    # the request line must carry THIS service's time
+                    # series (queue depth, lane occupancy, in-flight) —
+                    # build_report read the process-global sampler slot,
+                    # which a bench harness may own with a provider-less
+                    # sampler of its own. Only rebuild when the slot is
+                    # foreign/empty; in the normal posture build_report
+                    # already snapshotted this very sampler.
+                    from ..utils import telemetry as _telemetry
+
+                    if (
+                        self.sampler.ticks
+                        and _telemetry.current_sampler() is not self.sampler
+                    ):
+                        line["telemetry"] = self.sampler.snapshot()
+                    with self._report_lock:
+                        _report.append_jsonl(path, line)
                 except Exception as e:  # noqa: BLE001 — recording must
                     # never turn a served proof into a failure
                     _log(f"service: report write failed: {e!r}")
@@ -321,11 +506,9 @@ class ProvingService:
 
     def _serve_packed(self, batch: list, placement: Placement) -> int:
         """Proof-parallel packing: same-bucket requests run concurrently,
-        each pinned to its own chip via jax.default_device. Only reached
-        with recording off (see class docstring), so no report lines are
-        written; each request's `slo` dict still carries its SLO fields."""
-        import jax
-
+        each pinned to its own chip via jax.default_device, each with its
+        own contextvars-scoped flight recorder (so per-request report
+        lines are written exactly as in the sequential path)."""
         devices = (
             list(self.mesh.devices.ravel()) if self.mesh is not None
             else self.devices
@@ -334,17 +517,25 @@ class ProvingService:
 
         def run(i_req):
             i, req = i_req
-            with jax.default_device(devices[i % width]):
-                return self._run_request(req, placement, packed=width)
+            return self._serve_one(
+                req, placement, packed=width, device=devices[i % width]
+            )
 
         with ThreadPoolExecutor(max_workers=width) as pool:
             served = sum(pool.map(run, enumerate(batch)))
         return served
 
     def _run_request(
-        self, req: ProveRequest, placement: Placement, packed: int = 1
+        self,
+        req: ProveRequest,
+        placement: Placement,
+        packed: int = 1,
+        device=None,
     ) -> int:
+        import contextlib
+
         from ..prover.prover import prove
+        from ..utils import profiling as _prof
 
         serve_ts = time.perf_counter()
         queue_latency = serve_ts - req.submit_ts
@@ -362,11 +553,27 @@ class ProvingService:
             "queue_latency_s": round(queue_latency, 6),
             "cache_hit": hit,
         }
+        if device is not None:
+            import jax
+
+            device_ctx = jax.default_device(device)
+        else:
+            device_ctx = contextlib.nullcontext()
+        with self._stats_lock:
+            self._inflight += 1
+        _metrics.gauge_service("inflight", self._inflight)
         t0 = time.perf_counter()
         try:
             with _span(
                 "service_request", request=req.id, placement=placement.kind
-            ):
+            ), _prof.maybe_trace_capture(
+                f"req_{req.id}", force=req.capture_trace
+            ) as trace_dir, device_ctx:
+                if trace_dir:
+                    req.slo["trace_dir"] = trace_dir
+                    rec = _report.current_flight_recorder()
+                    if rec is not None:
+                        rec.trace_dir = trace_dir
                 proof = prove(
                     req.assembly, req.setup, req.config,
                     mesh=placement.mesh,
@@ -379,6 +586,7 @@ class ProvingService:
                 time.perf_counter() - t0, 6
             )
             with self._stats_lock:
+                self._inflight -= 1
                 self.stats["failed"] += 1
                 self.stats["queue_latency_s"] += queue_latency
             req._done.set()
@@ -390,6 +598,7 @@ class ProvingService:
         req.slo["prove_wall_s"] = round(wall, 6)
         req.slo["proofs_per_sec"] = round(packed / wall, 6) if wall else None
         with self._stats_lock:
+            self._inflight -= 1
             self.stats["served"] += 1
             self.stats["prove_wall_s"] += wall
             self.stats["queue_latency_s"] += queue_latency
@@ -426,4 +635,12 @@ class ProvingService:
             out["wall_s"] = round(wall_s, 4)
             if served and wall_s > 0:
                 out["proofs_per_sec"] = round(served / wall_s, 4)
+        out["telemetry"] = {
+            "ticks": self.sampler.ticks,
+            "interval_s": self.sampler.interval_s,
+            "metrics_port": (
+                self.metrics_plane.port
+                if self.metrics_plane is not None else None
+            ),
+        }
         return out

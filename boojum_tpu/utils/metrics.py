@@ -4,8 +4,11 @@ Counters (host↔device transfer bytes, NTT/Merkle/FRI invocation counts)
 and gauges (device-memory high water, live-buffer census) accumulated
 alongside the span tree. The module-level helpers (`count`, `gauge_max`,
 `stage_boundary`) are no-op-cheap when no registry is installed — one
-global read and a None check — so the prover keeps them threaded through
-its hot path permanently.
+contextvar read, one global read and a None check — so the prover keeps
+them threaded through its hot path permanently. Like the span recorder
+(utils/spans.py), the active registry resolves contextvar-first: a
+scoped registry (one packed service request) overrides the
+process-global default within its execution context only.
 
 Memory sources, best-effort by design:
 - `device.memory_stats()` (bytes_in_use / peak_bytes_in_use) where the
@@ -18,6 +21,7 @@ Memory sources, best-effort by design:
 
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 
@@ -84,18 +88,38 @@ class MetricsRegistry:
             }
 
 
+# process-global DEFAULT context (bench/CLI posture); scoped registries
+# (install_scoped_registry) override it per execution context so packed
+# concurrent requests accumulate into disjoint registries
 _REGISTRY: MetricsRegistry | None = None
+_REGISTRY_CTX: contextvars.ContextVar[MetricsRegistry | None] = (
+    contextvars.ContextVar("boojum_tpu.metrics_registry", default=None)
+)
 
 
 def current_registry() -> MetricsRegistry | None:
-    return _REGISTRY
+    """The ACTIVE registry: context-scoped when one is bound, else the
+    process-global default."""
+    reg = _REGISTRY_CTX.get()
+    return reg if reg is not None else _REGISTRY
 
 
 def install_registry(reg: MetricsRegistry | None) -> MetricsRegistry | None:
+    """Swap the process-wide DEFAULT registry; returns the previous one."""
     global _REGISTRY
     prev = _REGISTRY
     _REGISTRY = reg
     return prev
+
+
+def install_scoped_registry(reg: MetricsRegistry | None):
+    """Bind `reg` to the CURRENT execution context only; returns a token
+    for reset_scoped_registry."""
+    return _REGISTRY_CTX.set(reg)
+
+
+def reset_scoped_registry(token):
+    _REGISTRY_CTX.reset(token)
 
 
 def start_metrics() -> MetricsRegistry:
@@ -112,19 +136,19 @@ def stop_metrics() -> MetricsRegistry | None:
 
 
 def count(name: str, n: int = 1):
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count(name, n)
 
 
 def gauge_max(name: str, v: float):
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.gauge_max(name, v)
 
 
 def gauge_add(name: str, v: float):
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.gauge_add(name, v)
 
@@ -133,7 +157,7 @@ def count_upload(x):
     """Tally a fresh host->device upload of a device array `x` (the
     prover's explicit upload seams — prover._dev_cached, the sequenced
     stage-2 table uploads); passes `x` through."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         try:
             count_bytes_h2d(int(x.size) * x.dtype.itemsize)
@@ -145,14 +169,14 @@ def count_upload(x):
 def count_bytes_h2d(nbytes: int):
     """Host->device upload accounting (counted at the prover's explicit
     upload seams; transfers inside compiled graphs are invisible here)."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count("transfer.h2d_bytes", nbytes)
         reg.count("transfer.h2d_ops")
 
 
 def count_bytes_d2h(nbytes: int):
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count("transfer.d2h_bytes", nbytes)
         reg.count("transfer.d2h_ops")
@@ -165,7 +189,7 @@ def count_ici_all_to_all(crossing_bytes: float):
     owns the (D-1)/D topology math, this seam owns the gauge names:
     `ici.all_to_alls` / `ici.all_to_all_bytes` (and `ici.pivot_s` for the
     dispatch window, charged by shard_sweep's pivot timer)."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count("ici.all_to_alls")
         reg.gauge_add("ici.all_to_all_bytes", crossing_bytes)
@@ -174,7 +198,7 @@ def count_ici_all_to_all(crossing_bytes: float):
 def count_ici_all_gather(crossing_bytes: float):
     """Tally one explicit all-gather to replicated (caps, small node
     layers): `ici.all_gathers` / `ici.all_gather_bytes`."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count("ici.all_gathers")
         reg.gauge_add("ici.all_gather_bytes", crossing_bytes)
@@ -188,7 +212,7 @@ def count_service_cache(event: str, nbytes: int = 0):
       service.cache.hits / .misses / .evictions   (counters)
       service.cache.evicted_bytes                 (gauge, evictions only)
     """
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is None:
         return
     if event == "hit":
@@ -209,7 +233,7 @@ def count_aot(event: str):
       aot.bundle_misses / aot.stale_bundles / aot.corrupt_bundles
       aot.corrupt_entries
     """
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.count(f"aot.{event}")
 
@@ -219,7 +243,7 @@ def gauge_aot_add(name: str, v: float):
     bundle_bytes — the artifact store's wall/size axis; the report
     validator requires deserialize_s whenever aot hits/misses were
     counted)."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.gauge_add(f"aot.{name}", float(v))
 
@@ -227,13 +251,13 @@ def gauge_aot_add(name: str, v: float):
 def gauge_service(name: str, v: float):
     """Set a `service.<name>` gauge (queue depth, pinned bytes, occupancy
     — the proving service's per-request SLO axis)."""
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.gauge_set(f"service.{name}", float(v))
 
 
 def stage_boundary(label: str):
-    reg = _REGISTRY
+    reg = current_registry()
     if reg is not None:
         reg.boundary(label)
 

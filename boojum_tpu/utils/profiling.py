@@ -39,9 +39,14 @@ def profiling_enabled() -> bool:
 
 
 def set_profiling(on: bool | None):
-    """Programmatic override (None = follow the environment)."""
+    """Programmatic override (None = follow the environment). Turning
+    profiling ON (re)asserts the stderr handler — idempotently: toggling
+    twice in one process must never stack a second handler (each stage
+    line would print once per toggle)."""
     global _FORCED
     _FORCED = on
+    if on:
+        ensure_stderr_handler()
 
 
 class _GatedStderrHandler(logging.Handler):
@@ -60,18 +65,48 @@ class _GatedStderrHandler(logging.Handler):
 
 
 logger = logging.getLogger("boojum_tpu")
-if not any(isinstance(h, _GatedStderrHandler) for h in logger.handlers):
-    _handler = _GatedStderrHandler()
-    _handler.setFormatter(logging.Formatter("[boojum_tpu] %(message)s"))
-    logger.addHandler(_handler)
-    logger.setLevel(logging.INFO)
-    # quiet by default: per-stage INFO records must not leak into an
-    # application's root handlers (propagation skips ancestor LOGGER
-    # levels, so a plain basicConfig() would otherwise print every stage
-    # line even with profiling off). Handlers attached directly to the
-    # "boojum_tpu" logger still receive everything; an embedder that wants
-    # the records in its root pipeline flips propagate back on.
-    logger.propagate = False
+
+# the stderr handler is identified by NAME, not class identity: an
+# isinstance guard breaks the moment this module is re-executed (reload,
+# a second standalone load) because the re-defined class is a different
+# object — and every per-stage line then prints once per stale handler
+_STDERR_HANDLER_NAME = "boojum_tpu.gated_stderr"
+
+
+def ensure_stderr_handler(
+    target_logger: logging.Logger | None = None,
+    _set_defaults: bool = False,
+) -> logging.Handler:
+    """Install the gated stderr handler on `target_logger` (default: the
+    library logger) exactly once per logger, keyed by handler name so
+    repeated installs — BOOJUM_TPU_PROFILE toggled twice, a module
+    re-execution — are no-ops returning the live handler.
+
+    `_set_defaults` applies the library's level/propagate posture ONLY
+    on a fresh install: a re-execution must not clobber an embedder
+    that re-raised the level or flipped propagate back on."""
+    lg = target_logger if target_logger is not None else logger
+    for h in lg.handlers:
+        if getattr(h, "name", None) == _STDERR_HANDLER_NAME:
+            return h
+    h = _GatedStderrHandler()
+    h.name = _STDERR_HANDLER_NAME
+    h.setFormatter(logging.Formatter("[boojum_tpu] %(message)s"))
+    lg.addHandler(h)
+    if _set_defaults:
+        lg.setLevel(logging.INFO)
+        # quiet by default: per-stage INFO records must not leak into an
+        # application's root handlers (propagation skips ancestor LOGGER
+        # levels, so a plain basicConfig() would otherwise print every
+        # stage line even with profiling off). Handlers attached
+        # directly to the "boojum_tpu" logger still receive everything;
+        # an embedder that wants the records in its root pipeline flips
+        # propagate back on.
+        lg.propagate = False
+    return h
+
+
+ensure_stderr_handler(logger, _set_defaults=True)
 
 
 def log(msg: str):
@@ -384,3 +419,157 @@ def stop_compile_ledger() -> CompileLedger | None:
     led = _LEDGER
     _LEDGER = None
     return led
+
+
+# ---------------------------------------------------------------------------
+# On-demand jax.profiler trace capture (BOOJUM_TPU_XPROF)
+# ---------------------------------------------------------------------------
+
+# BOOJUM_TPU_XPROF=<dir>[:N] arms a process-wide capture budget: the
+# next N proves (default 1) each record a jax.profiler trace into a
+# fresh subdirectory of <dir>, and the directory lands in the prove's
+# ProveReport line (`trace` record) so every trace is attributable to
+# the request that produced it. The budget is claimed under a lock —
+# packed concurrent proves never double-capture — and re-arms whenever
+# the env value CHANGES (re-exporting the same value keeps the spent
+# budget). All state is immutable-valued globals rebound under
+# _XPROF_LOCK; the profiler itself is a process singleton, so `_ACTIVE`
+# additionally guarantees no nested/overlapping capture attempts.
+_XPROF_ENV: str | None = None
+_XPROF_DIR: str | None = None
+_XPROF_REMAINING: int = 0
+_XPROF_SEQ: int = 0
+_XPROF_ACTIVE: bool = False
+_XPROF_LOCK = threading.Lock()
+
+
+def _parse_xprof(raw: str) -> tuple[str, int]:
+    """"<dir>[:N]" -> (dir, N); a trailing :N only counts when numeric,
+    so paths containing colons stay usable."""
+    raw = raw.strip()
+    n = 1
+    head, sep, tail = raw.rpartition(":")
+    if sep and tail.isdigit():
+        raw, n = head, int(tail)
+    return raw, max(0, n)
+
+
+def xprof_remaining() -> int:
+    """Captures left in the armed budget (0 = disarmed) — refreshes
+    from the environment first, like maybe_trace_capture does."""
+    with _XPROF_LOCK:
+        _xprof_refresh_locked()
+        return _XPROF_REMAINING
+
+
+def _xprof_refresh_locked():
+    global _XPROF_ENV, _XPROF_DIR, _XPROF_REMAINING
+    env = os.environ.get("BOOJUM_TPU_XPROF", "").strip()
+    if env == (_XPROF_ENV or ""):
+        return
+    _XPROF_ENV = env
+    if not env:
+        _XPROF_DIR = None
+        _XPROF_REMAINING = 0
+        return
+    _XPROF_DIR, _XPROF_REMAINING = _parse_xprof(env)
+
+
+def _xprof_claim(label: str, force: bool) -> tuple[str | None, bool]:
+    """Claim one capture slot; returns (trace directory or None,
+    whether a budget slot was consumed — so a failed start can refund
+    it)."""
+    global _XPROF_REMAINING, _XPROF_SEQ, _XPROF_ACTIVE
+    import re as _re
+
+    with _XPROF_LOCK:
+        if _XPROF_ACTIVE:
+            if force:
+                # the caller EXPLICITLY asked for this trace — losing it
+                # to an in-flight sibling capture must be visible, not a
+                # silently missing `trace` record
+                log(
+                    f"xprof: capture_trace for {label!r} skipped — "
+                    f"another capture is in flight (profiler is a "
+                    f"process singleton)"
+                )
+            return None, False
+        _xprof_refresh_locked()
+        base = _XPROF_DIR
+        consumed = False
+        if force:
+            # a forced (per-request) capture never burns the ambient
+            # BOOJUM_TPU_XPROF budget — that budget is armed for the
+            # next N un-flagged proves
+            if base is None:
+                import tempfile
+
+                base = os.path.join(
+                    tempfile.gettempdir(), "boojum_tpu_xprof"
+                )
+        elif _XPROF_REMAINING > 0:
+            _XPROF_REMAINING -= 1
+            consumed = True
+        else:
+            return None, False
+        seq = _XPROF_SEQ
+        _XPROF_SEQ += 1
+        _XPROF_ACTIVE = True
+    safe = _re.sub(r"[^A-Za-z0-9_.-]", "_", label) or "capture"
+    return os.path.join(base, f"{safe}-{seq:03d}"), consumed
+
+
+def _xprof_refund():
+    """Give a consumed budget slot back (the trace failed to START, so
+    the armed capture should still cover a later prove)."""
+    global _XPROF_REMAINING
+    with _XPROF_LOCK:
+        _XPROF_REMAINING += 1
+
+
+@contextlib.contextmanager
+def maybe_trace_capture(label: str, force: bool = False):
+    """Capture a jax.profiler trace around the block when the
+    BOOJUM_TPU_XPROF budget has captures remaining, or unconditionally
+    with `force=True` (the service's per-request capture_trace flag —
+    without an armed env dir, forced traces land under the system temp
+    dir). Yields the trace directory, or None when not capturing.
+    Capture failures log and degrade to None — profiling must never
+    fail a prove."""
+    global _XPROF_ACTIVE
+    trace_dir, consumed = _xprof_claim(label, force)
+    if trace_dir is None:
+        yield None
+        return
+    started = False
+    try:
+        try:
+            import jax
+
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            started = True
+            log(f"xprof: capturing {label!r} -> {trace_dir}")
+        except Exception as e:
+            log(f"xprof: trace capture failed to start: {e!r}")
+            if consumed:
+                _xprof_refund()  # the armed budget still owes a capture
+            # nothing is capturing: release the singleton NOW, not at
+            # the end of the (possibly minutes-long) wrapped prove —
+            # a concurrent forced capture must not be refused against
+            # a phantom in-flight trace. The finally below then only
+            # clears ACTIVE for a capture WE started, so it can never
+            # stomp a sibling's claim made after this release.
+            with _XPROF_LOCK:
+                _XPROF_ACTIVE = False
+        yield trace_dir if started else None
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception as e:
+                log(f"xprof: stop_trace failed: {e!r}")
+            with _XPROF_LOCK:
+                _XPROF_ACTIVE = False

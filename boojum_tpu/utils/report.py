@@ -25,13 +25,19 @@ artifacts. The recording entry points import spans/metrics lazily.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import hashlib
 import json
 import os
 import time
 
 REPORT_KIND = "boojum_tpu.prove_report"
-REPORT_SCHEMA = 1
+# schema 2 (ISSUE 9): lines may carry a `telemetry` record (background
+# sampler time series, utils/telemetry.py) and a `trace` record (an
+# on-demand jax.profiler capture attributable to the line); schema-1
+# lines remain valid for --check/--diff
+REPORT_SCHEMA = 2
+ACCEPTED_SCHEMAS = (1, 2)
 
 # canonical Fiat–Shamir round order; validation checks checkpoint rounds
 # never decrease along the stream
@@ -82,24 +88,43 @@ class CheckpointLog:
         )
 
 
+# process-global DEFAULT context; scoped logs (install_scoped_* /
+# flight_recording(scoped=True)) override per execution context so
+# packed concurrent proves keep disjoint checkpoint streams
 _CHECKPOINTS: CheckpointLog | None = None
+_CHECKPOINTS_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "boojum_tpu.checkpoint_log", default=None
+)
 
 
 def current_checkpoint_log() -> CheckpointLog | None:
-    return _CHECKPOINTS
+    log = _CHECKPOINTS_CTX.get()
+    return log if log is not None else _CHECKPOINTS
 
 
 def install_checkpoint_log(log: CheckpointLog | None):
+    """Swap the process-wide DEFAULT checkpoint log; returns the
+    previous one."""
     global _CHECKPOINTS
     prev = _CHECKPOINTS
     _CHECKPOINTS = log
     return prev
 
 
+def install_scoped_checkpoint_log(log: CheckpointLog | None):
+    """Bind `log` to the CURRENT execution context only; returns a token
+    for reset_scoped_checkpoint_log."""
+    return _CHECKPOINTS_CTX.set(log)
+
+
+def reset_scoped_checkpoint_log(token):
+    _CHECKPOINTS_CTX.reset(token)
+
+
 def checkpoint(round_: int, label: str, values):
-    """Record one Fiat–Shamir digest checkpoint; no-op-cheap (one global
-    read) when nothing is recording."""
-    log = _CHECKPOINTS
+    """Record one Fiat–Shamir digest checkpoint; no-op-cheap (one
+    contextvar read, one global read) when nothing is recording."""
+    log = current_checkpoint_log()
     if log is not None:
         log.add(round_, label, values)
 
@@ -122,6 +147,10 @@ class FlightRecorder:
         self.checkpoints = CheckpointLog()
         self._t0 = time.perf_counter()
         self.wall_s: float | None = None
+        # an on-demand jax.profiler capture directory for this recorded
+        # window (profiling.maybe_trace_capture) — lands in the report
+        # line's `trace` record so the trace is attributable
+        self.trace_dir: str | None = None
 
     def close(self):
         if self.wall_s is None:
@@ -129,21 +158,49 @@ class FlightRecorder:
 
 
 _FLIGHT: FlightRecorder | None = None
+_FLIGHT_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "boojum_tpu.flight_recorder", default=None
+)
 
 
 def current_flight_recorder() -> FlightRecorder | None:
-    return _FLIGHT
+    rec = _FLIGHT_CTX.get()
+    return rec if rec is not None else _FLIGHT
 
 
 @contextlib.contextmanager
-def flight_recording(label: str | None = None, sync: bool = True):
+def flight_recording(
+    label: str | None = None, sync: bool = True, scoped: bool = False
+):
     """Install a FlightRecorder (spans + metrics + checkpoints) for the
-    duration of the block; restores whatever was installed before."""
+    duration of the block; restores whatever was installed before.
+
+    `scoped=True` binds the collectors to the CURRENT execution context
+    via contextvars instead of swapping the process-global defaults —
+    the packed proving-service posture, where several requests record
+    concurrently on pool threads without corrupting each other's spans,
+    counters or checkpoint streams. The default (scoped=False) keeps the
+    process-global swap bench/CLI flows rely on: threads they spawn
+    mid-recording (the precompile pool) still see the recorder."""
     global _FLIGHT
     from . import metrics as _metrics
     from . import spans as _spans
 
     rec = FlightRecorder(label=label, sync=sync)
+    if scoped:
+        tok_flight = _FLIGHT_CTX.set(rec)
+        tok_spans = _spans.install_scoped_recorder(rec.spans)
+        tok_metrics = _metrics.install_scoped_registry(rec.metrics)
+        tok_ckpt = install_scoped_checkpoint_log(rec.checkpoints)
+        try:
+            yield rec
+        finally:
+            rec.close()
+            _spans.reset_scoped_recorder(tok_spans)
+            _metrics.reset_scoped_registry(tok_metrics)
+            reset_scoped_checkpoint_log(tok_ckpt)
+            _FLIGHT_CTX.reset(tok_flight)
+        return
     prev_flight = _FLIGHT
     _FLIGHT = rec
     prev_spans = _spans.install_recorder(rec.spans)
@@ -171,6 +228,19 @@ def build_report(rec: FlightRecorder, extra: dict | None = None) -> dict:
         "metrics": rec.metrics.to_dict(),
         "checkpoints": list(rec.checkpoints.entries),
     }
+    if rec.trace_dir:
+        d["trace"] = {"dir": rec.trace_dir}
+    try:
+        # the live telemetry plane's time series (utils/telemetry.py):
+        # when a sampler is running, every report line carries the
+        # service-wide memory/queue/in-flight samples that overlapped it
+        from . import telemetry as _telemetry
+
+        sampler = _telemetry.current_sampler()
+        if sampler is not None:
+            d["telemetry"] = sampler.snapshot()
+    except Exception:
+        pass
     try:
         from .profiling import current_compile_ledger
 
@@ -257,14 +327,24 @@ def validate_report(report: dict) -> list[str]:
     problems: list[str] = []
     if report.get("kind") != REPORT_KIND:
         problems.append(f"kind is {report.get('kind')!r}, want {REPORT_KIND!r}")
-    if report.get("schema") != REPORT_SCHEMA:
+    if report.get("schema") not in ACCEPTED_SCHEMAS:
         problems.append(
-            f"schema is {report.get('schema')!r}, want {REPORT_SCHEMA}"
+            f"schema is {report.get('schema')!r}, want one of "
+            f"{ACCEPTED_SCHEMAS}"
         )
     wall = report.get("wall_s")
     if not isinstance(wall, (int, float)) or wall < 0:
         problems.append(f"wall_s invalid: {wall!r}")
+    # context-scoped recording invariant (ISSUE 9): one report line is
+    # ONE request's flight data. Span attrs carrying two distinct
+    # request ids on a single line mean a scoped collector bled across
+    # packed requests — the corruption mode the contextvar scoping
+    # exists to prevent, so it must fail the gate loudly.
+    span_request_ids = set()
     for path, sp in _walk_spans(report.get("spans", ())):
+        attrs = sp.get("attrs")
+        if isinstance(attrs, dict) and attrs.get("request") is not None:
+            span_request_ids.add(str(attrs["request"]))
         w = sp.get("wall_s")
         if not isinstance(w, (int, float)) or w < 0:
             problems.append(f"span {'/'.join(path)}: wall_s invalid: {w!r}")
@@ -464,6 +544,66 @@ def validate_report(report: dict) -> list[str]:
             ):
                 problems.append(
                     f"request prove_wall_s invalid: {pw!r}"
+                )
+            if request.get("id") is not None:
+                span_request_ids.add(str(request["id"]))
+    if len(span_request_ids) > 1:
+        problems.append(
+            "line mixes request ids "
+            f"{sorted(span_request_ids)}: scoped collectors bled "
+            "across packed requests"
+        )
+    # telemetry record (schema 2, utils/telemetry.py): the background
+    # sampler's time series. Samples must be time-ordered with finite
+    # non-negative readings — a sampler writing junk would poison every
+    # dashboard fed from these lines.
+    telemetry = report.get("telemetry")
+    if telemetry is not None:
+        problems.extend(_validate_telemetry(telemetry))
+    trace = report.get("trace")
+    if trace is not None and not (
+        isinstance(trace, dict) and isinstance(trace.get("dir"), str)
+        and trace["dir"]
+    ):
+        problems.append(f"trace record malformed: {trace!r}")
+    return problems
+
+
+def _validate_telemetry(telemetry) -> list[str]:
+    if not isinstance(telemetry, dict):
+        return [f"telemetry record malformed: {type(telemetry).__name__}"]
+    problems: list[str] = []
+    iv = telemetry.get("interval_s")
+    if not isinstance(iv, (int, float)) or iv != iv or iv <= 0:
+        problems.append(f"telemetry interval_s invalid: {iv!r}")
+    ticks = telemetry.get("ticks")
+    if not isinstance(ticks, int) or ticks < 0:
+        problems.append(f"telemetry ticks invalid: {ticks!r}")
+    samples = telemetry.get("samples")
+    if not isinstance(samples, list):
+        return problems + [
+            f"telemetry samples missing/malformed: {type(samples).__name__}"
+        ]
+    last_t = float("-inf")
+    for i, s in enumerate(samples):
+        if not isinstance(s, dict):
+            problems.append(f"telemetry sample {i}: not a dict")
+            continue
+        t = s.get("t_s")
+        if not isinstance(t, (int, float)) or t != t or t < 0:
+            problems.append(f"telemetry sample {i}: t_s invalid: {t!r}")
+        elif t < last_t:
+            problems.append(
+                f"telemetry sample {i}: t_s {t} decreases (after {last_t})"
+            )
+        else:
+            last_t = t
+        for k, v in s.items():
+            if k == "t_s":
+                continue
+            if not isinstance(v, (int, float)) or v != v or v < 0:
+                problems.append(
+                    f"telemetry sample {i}: {k} invalid: {v!r}"
                 )
     return problems
 
@@ -758,6 +898,21 @@ def render_report(report: dict, top: int = 10) -> str:
             f"    [{e.get('seq'):>3}] r{e.get('round')} "
             f"{e.get('label'):<28} {str(e.get('digest'))[:16]}…"
         )
+    telemetry = report.get("telemetry")
+    if isinstance(telemetry, dict):
+        samples = telemetry.get("samples") or []
+        keys = sorted(
+            {k for s in samples if isinstance(s, dict) for k in s}
+            - {"t_s"}
+        )
+        lines.append(
+            f"  telemetry: {len(samples)} samples @ "
+            f"{telemetry.get('interval_s')}s "
+            f"({telemetry.get('ticks')} ticks) keys={keys}"
+        )
+    trace = report.get("trace")
+    if isinstance(trace, dict):
+        lines.append(f"  profiler trace: {trace.get('dir')}")
     request = report.get("request")
     if isinstance(request, dict):
         lines.append(

@@ -16,6 +16,14 @@ spans (``stage=True``) additionally feed the legacy flat stage sink
 (`profiling.collect_stages`) and the per-stage stderr log line, so
 `bench.py`'s stage split keeps working unchanged.
 
+Scoping (ISSUE 9): the ACTIVE recorder is resolved contextvar-first —
+`install_scoped_recorder` binds a recorder to the current execution
+context (one packed proving-service request on its pool thread), while
+`install_recorder` keeps setting the process-global DEFAULT context that
+bench/CLI flows rely on. Concurrent scoped contexts record into disjoint
+trees; code that never scopes sees exactly the old process-global
+behavior.
+
 Explicit device sync points: `sync_point(x, label)` calls
 `jax.block_until_ready` when an installed recorder asks for synced spans,
 charging asynchronously-dispatched device work to the stage that issued it
@@ -25,6 +33,7 @@ instead of whichever later stage first touches the result.
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import os
 import threading
 import time
@@ -117,19 +126,46 @@ class SpanRecorder:
             return [_clean(r) for r in self.roots]
 
 
+# process-global DEFAULT context (bench/CLI posture: one recorder owns
+# the whole process) — immutable None or a SpanRecorder reference; all
+# mutable collector state lives inside recorder instances
 _RECORDER: SpanRecorder | None = None
+# contextvar override: a scoped recorder bound to one execution context
+# (e.g. one packed proving-service request on its pool thread). Threads
+# start with an EMPTY context, so a freshly spawned worker falls back to
+# the process-global default unless it scopes its own recorder.
+_RECORDER_CTX: contextvars.ContextVar[SpanRecorder | None] = (
+    contextvars.ContextVar("boojum_tpu.span_recorder", default=None)
+)
 
 
 def current_recorder() -> SpanRecorder | None:
-    return _RECORDER
+    """The ACTIVE recorder: context-scoped when one is bound, else the
+    process-global default."""
+    rec = _RECORDER_CTX.get()
+    return rec if rec is not None else _RECORDER
 
 
 def install_recorder(rec: SpanRecorder | None) -> SpanRecorder | None:
-    """Swap the process-wide recorder; returns the previous one."""
+    """Swap the process-wide DEFAULT recorder; returns the previous one.
+    Scoped recorders (install_scoped_recorder) override this within
+    their context."""
     global _RECORDER
     prev = _RECORDER
     _RECORDER = rec
     return prev
+
+
+def install_scoped_recorder(rec: SpanRecorder | None):
+    """Bind `rec` to the CURRENT execution context only (this thread /
+    task); returns a token for reset_scoped_recorder. Other contexts —
+    including the process-global default — are untouched, so concurrent
+    packed requests each record into their own tree."""
+    return _RECORDER_CTX.set(rec)
+
+
+def reset_scoped_recorder(token):
+    _RECORDER_CTX.reset(token)
 
 
 def start_recording(sync: bool = True) -> SpanRecorder:
@@ -146,7 +182,7 @@ def span_attr(name: str, value):
     """Attach an attribute to the CURRENTLY OPEN span (no-op when nothing
     records) — for call sites that learn something mid-span worth auditing
     per report, e.g. which axis shard_cols actually sharded."""
-    rec = _RECORDER
+    rec = current_recorder()
     if rec is None:
         return
     sp = rec.current()
@@ -163,7 +199,7 @@ def span(name: str, stage: bool = False, **attrs):
     observable surface). Exception-safe: a raising body still records the
     span, with an ``error`` field (ISSUE 2 satellite: the old stage_timer
     lost the timing line entirely)."""
-    rec = _RECORDER
+    rec = current_recorder()
     trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
     if (
         rec is None
@@ -208,7 +244,7 @@ def sync_point(x, label: str | None = None):
     """Block on `x` (jax.block_until_ready) when the installed recorder
     wants synced spans, charging the wait to the current span as `sync_s`.
     Passes `x` through unchanged; a no-op without a recorder."""
-    rec = _RECORDER
+    rec = current_recorder()
     if rec is None or not rec.sync or x is None:
         return x
     import jax

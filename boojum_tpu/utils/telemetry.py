@@ -1,0 +1,218 @@
+"""Background telemetry sampler — the live service's time axis.
+
+The flight recorder (spans/metrics/report) answers "what did THIS prove
+do"; this module answers "what is the PROCESS doing right now": a daemon
+thread snapshots `device.memory_stats()`, the `jax.live_arrays()`
+census, and any registered provider callables (the proving service
+registers queue depth, per-lane occupancy and in-flight count) on a
+fixed cadence into
+
+- current-value gauges on the sampler's own MetricsRegistry (what the
+  HTTP `/metrics` endpoint renders as Prometheus text), plus
+  `gauge_max` high-water marks, and
+- a bounded ring of time-stamped samples — the `telemetry` record that
+  `report.build_report` attaches to every ProveReport line while a
+  sampler is running (schema 2), so a request line shows the queue and
+  memory pressure that surrounded it.
+
+Cadence rides BOOJUM_TPU_TELEMETRY_INTERVAL (seconds, default 1.0).
+Sampling is best-effort by design: a provider that raises is skipped
+for that tick (and counted on `telemetry.provider_errors`), never
+crashing the service. The module-level current-sampler slot follows the
+same install/current pattern as the other collectors — a single
+immutable reference, swapped whole.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+
+from . import metrics as _metrics
+
+DEFAULT_INTERVAL_S = 1.0
+# ring-buffer bound: at the default 1 Hz cadence this is ~10 minutes of
+# history; the per-report `telemetry` record is clipped harder (below)
+MAX_SAMPLES = 600
+# samples attached to one ProveReport line — enough to cover a prove's
+# window without bloating the JSONL artifact
+SNAPSHOT_SAMPLES = 60
+
+
+def telemetry_interval_s() -> float:
+    """BOOJUM_TPU_TELEMETRY_INTERVAL: sampler cadence in seconds
+    (default 1.0; must be > 0)."""
+    v = os.environ.get("BOOJUM_TPU_TELEMETRY_INTERVAL", "").strip()
+    if not v:
+        return DEFAULT_INTERVAL_S
+    iv = float(v)
+    if iv <= 0:
+        raise ValueError(
+            f"BOOJUM_TPU_TELEMETRY_INTERVAL={v!r}: must be > 0 seconds"
+        )
+    return iv
+
+
+class TelemetrySampler:
+    """Periodic snapshotter. `providers` map gauge-suffix -> zero-arg
+    callable returning a number or a {suffix: number} dict; built-in
+    sources (device memory, live-buffer census) always sample."""
+
+    def __init__(
+        self,
+        interval_s: float | None = None,
+        registry: "_metrics.MetricsRegistry | None" = None,
+        max_samples: int = MAX_SAMPLES,
+    ):
+        self.interval_s = (
+            telemetry_interval_s() if interval_s is None else float(interval_s)
+        )
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.registry = registry or _metrics.MetricsRegistry()
+        self._providers: dict[str, object] = {}
+        self._samples: collections.deque = collections.deque(
+            maxlen=max_samples
+        )
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._t0 = time.perf_counter()
+        self.ticks = 0
+        self.provider_errors = 0
+
+    # ---- providers -------------------------------------------------------
+    def add_provider(self, name: str, fn) -> None:
+        """Register a sample source: `fn()` returns a number (recorded
+        as `telemetry.<name>`) or a dict of {suffix: number} (recorded
+        as `telemetry.<name>.<suffix>`)."""
+        with self._lock:
+            self._providers[name] = fn
+
+    # ---- sampling --------------------------------------------------------
+    def sample_once(self) -> dict:
+        """Take one snapshot NOW (also what the daemon thread does each
+        tick): returns the flat sample dict that entered the ring."""
+        sample: dict = {
+            "t_s": round(time.perf_counter() - self._t0, 3)
+        }
+        census = _metrics.live_buffer_census()
+        if census is not None:
+            sample["live_arrays"], sample["live_bytes"] = census
+        dm = _metrics.device_memory_stats()
+        if dm:
+            sample["device_bytes_in_use"] = dm.get("bytes_in_use", 0)
+            peak = dm.get("peak_bytes_in_use")
+            if peak is not None:
+                sample["device_peak_bytes_in_use"] = peak
+        with self._lock:
+            providers = list(self._providers.items())
+        for name, fn in providers:
+            # the value CONVERSION is inside the guard too: a provider
+            # returning junk (None in a dict, a string) must be skipped
+            # and counted, never crash the sampler — start() calls this
+            # synchronously, so an escape would abort run_worker
+            try:
+                v = fn()
+                if isinstance(v, dict):
+                    for suffix, sv in v.items():
+                        sample[f"{name}.{suffix}"] = float(sv)
+                elif v is not None:
+                    sample[name] = float(v)
+            except Exception:
+                self.provider_errors += 1
+                self.registry.count("telemetry.provider_errors")
+                continue
+        for k, v in sample.items():
+            if k == "t_s":
+                continue
+            self.registry.gauge_set(f"telemetry.{k}", float(v))
+            self.registry.gauge_max(f"telemetry.{k}_high_water", float(v))
+        with self._lock:
+            self._samples.append(sample)
+            self.ticks += 1
+        self.registry.gauge_set("telemetry.ticks", self.ticks)
+        return sample
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample_once()
+            except Exception:
+                # the sampler must never take the service down; one bad
+                # tick (e.g. a backend probe raising mid-teardown) is
+                # dropped, the next tick retries
+                self.provider_errors += 1
+
+    def start(self) -> "TelemetrySampler":
+        # clear BEFORE the liveness check: a thread whose stop() timed
+        # out mid-drain (wedged provider) resumes sampling instead of
+        # observing the stale stop event and dying silently; if it was
+        # already past its loop exit, the next start() sees a dead
+        # handle and respawns
+        self._stop.clear()
+        t = self._thread
+        if t is not None and t.is_alive():
+            return self
+        self.sample_once()  # one synchronous baseline sample
+        self._thread = threading.Thread(
+            target=self._run, name="boojum-telemetry", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.interval_s + 1.0)
+            if t.is_alive():
+                # a provider is wedged past the join budget: keep the
+                # handle so running() stays truthful and a later start()
+                # can never spawn a DUPLICATE sampler over the same ring
+                return
+        self._thread = None
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    # ---- views -----------------------------------------------------------
+    def snapshot(self, limit: int = SNAPSHOT_SAMPLES) -> dict:
+        """The report-line `telemetry` record: cadence + tick count +
+        the most recent `limit` samples (time-ordered)."""
+        with self._lock:
+            samples = list(self._samples)[-limit:]
+        return {
+            "interval_s": self.interval_s,
+            "ticks": self.ticks,
+            "samples": samples,
+        }
+
+    def series(self, key: str) -> list[tuple[float, float]]:
+        """(t_s, value) pairs of one sampled key — dashboard food."""
+        with self._lock:
+            return [
+                (s["t_s"], s[key]) for s in self._samples if key in s
+            ]
+
+
+_SAMPLER: TelemetrySampler | None = None
+
+
+def current_sampler() -> TelemetrySampler | None:
+    return _SAMPLER
+
+
+def install_sampler(
+    sampler: TelemetrySampler | None,
+) -> TelemetrySampler | None:
+    """Swap the process-wide sampler slot (report.build_report reads it
+    to attach the `telemetry` record); returns the previous one. The
+    caller owns start()/stop()."""
+    global _SAMPLER
+    prev = _SAMPLER
+    _SAMPLER = sampler
+    return prev

@@ -17,16 +17,29 @@ Environment (see README "Environment flags"):
   BOOJUM_TPU_SERVICE_QUEUE_CAP    admission-queue bound (default 64)
   BOOJUM_TPU_SERVICE_CACHE_BYTES  device-cache LRU cap (default 2 GiB)
   BOOJUM_TPU_SERVICE_SHARD_ROWS   shard-parallel trace threshold (2^17)
-  BOOJUM_TPU_SERVICE_MAX_INFLIGHT proof-parallel pack width (default 1)
+  BOOJUM_TPU_SERVICE_MAX_INFLIGHT proof-parallel pack width (default 1);
+                                  packed requests each record their own
+                                  report line (contextvars-scoped
+                                  flight recorder)
   BOOJUM_TPU_SERVICE_PRECOMPILE   full | lower | off (default full)
+  BOOJUM_TPU_SERVICE_METRICS_PORT HTTP telemetry port (--metrics-port
+                                  overrides; 0 = any free port)
+  BOOJUM_TPU_TELEMETRY_INTERVAL   background sampler cadence, seconds
+                                  (default 1.0)
+  BOOJUM_TPU_XPROF                <dir>[:N] — capture jax.profiler
+                                  traces of the next N proves
   BOOJUM_TPU_REPORT               default report path (per-request SLO
                                   JSONL; --report overrides)
 
 Each served request appends one ProveReport JSONL line carrying the
 `request` SLO record (queue latency, placement, occupancy, prove wall,
-proofs/sec, cache hit) on top of the flight recorder's span/metrics/
-checkpoint axes. Validate with `scripts/prove_report.py --check`,
-summarize with `--slo`.
+proofs/sec, cache hit, trace dir when captured) on top of the flight
+recorder's span/metrics/checkpoint axes and the sampler's `telemetry`
+time series. Validate with `scripts/prove_report.py --check`, summarize
+with `--slo`. With `--metrics-port P` the worker loop serves live
+telemetry on 127.0.0.1:P — `/metrics` (Prometheus text: queue depth,
+lane occupancy, in-flight count, device memory, live-buffer census),
+`/healthz`, `/slo` — while it drains.
 """
 
 import argparse
@@ -119,6 +132,15 @@ def main(argv=None) -> int:
     ap.add_argument("--report", metavar="OUT_JSONL",
                     help="per-request SLO report path "
                          "(default: BOOJUM_TPU_REPORT)")
+    ap.add_argument("--metrics-port", type=int, metavar="PORT",
+                    help="serve live telemetry over HTTP while the "
+                         "worker drains: /metrics (Prometheus text), "
+                         "/healthz, /slo (0 = any free port; default: "
+                         "BOOJUM_TPU_SERVICE_METRICS_PORT)")
+    ap.add_argument("--capture-trace", action="store_true",
+                    help="record a jax.profiler trace of the FIRST "
+                         "submitted job (per-request capture_trace "
+                         "flag; see also BOOJUM_TPU_XPROF)")
     ap.add_argument("--verify", action="store_true",
                     help="verify every proof after the drain")
     args = ap.parse_args(argv)
@@ -135,6 +157,8 @@ def main(argv=None) -> int:
     cfg = ServiceConfig.from_env()
     if args.report:
         cfg.report_path = args.report
+    if args.metrics_port is not None:
+        cfg.metrics_port = args.metrics_port
     svc = ProvingService(cfg)
     print(
         f"service up: {len(svc.devices)} devices, "
@@ -144,6 +168,22 @@ def main(argv=None) -> int:
         f"precompile={svc.warmer.mode}",
         file=sys.stderr,
     )
+    if cfg.metrics_port is not None:
+        # start the plane BEFORE admission so an operator can watch the
+        # queue fill; run_worker leaves a caller-started plane running
+        port = svc.start_telemetry(cfg.metrics_port)
+        if port is not None:
+            print(
+                f"telemetry: http://127.0.0.1:{port}/metrics "
+                f"(/healthz /slo)",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                "telemetry: endpoint failed to bind — sampler-only "
+                "(see service log)",
+                file=sys.stderr,
+            )
 
     specs = demo_jobs(args.demo) if args.demo else json.load(open(args.jobs))
     requests = []
@@ -163,6 +203,8 @@ def main(argv=None) -> int:
                 asm, setup, config,
                 priority=spec.get("priority", "batch"),
                 tenant=spec.get("tenant", "default"),
+                # first job only: one attributable trace, not N
+                capture_trace=bool(args.capture_trace and not requests),
             )
             try:
                 requests.append(submit())
@@ -177,6 +219,7 @@ def main(argv=None) -> int:
                 svc.run_worker()
                 requests.append(submit())
     summary = svc.run_worker()
+    svc.stop_telemetry()
     print(json.dumps(summary))
 
     failed = [r for r in requests if r.error is not None]

@@ -113,6 +113,44 @@ def test_log_composes_with_user_handlers():
     assert "user handler sees this" in records
 
 
+def test_stderr_handler_install_is_idempotent():
+    """Satellite (ISSUE 9): toggling BOOJUM_TPU_PROFILE twice in one
+    process (set_profiling on/off/on), or re-running the module-level
+    install, must never stack a second stderr handler — each stage line
+    would then print once per toggle. The handler is keyed by NAME, not
+    class identity, so even a re-executed module (stale class object)
+    cannot defeat the guard."""
+    logger = logging.getLogger("boojum_tpu")
+
+    def gated_handlers():
+        return [
+            h for h in logger.handlers
+            if getattr(h, "name", None) == profiling._STDERR_HANDLER_NAME
+        ]
+
+    assert len(gated_handlers()) == 1  # module import installed exactly one
+    try:
+        for _ in range(3):  # "toggled twice" and then some
+            profiling.set_profiling(True)
+            profiling.set_profiling(False)
+        profiling.ensure_stderr_handler()
+        profiling.ensure_stderr_handler()
+        assert len(gated_handlers()) == 1
+        # the line really prints ONCE, not once per toggle
+        err = io.StringIO()
+        old = sys.stderr
+        sys.stderr = err
+        try:
+            profiling.set_profiling(True)
+            profiling.log("single emission")
+        finally:
+            sys.stderr = old
+            profiling.set_profiling(None)
+        assert err.getvalue().count("single emission") == 1
+    finally:
+        profiling.set_profiling(None)
+
+
 def test_log_stderr_gated_on_profiling_env():
     err = io.StringIO()
     old = sys.stderr
@@ -128,6 +166,145 @@ def test_log_stderr_gated_on_profiling_env():
     out = err.getvalue()
     assert "hidden line" not in out
     assert "[boojum_tpu] visible line" in out
+
+
+# ---------------------------------------------------------------------------
+# Contextvars scoping (ISSUE 9): the packed-service concurrency contract
+# ---------------------------------------------------------------------------
+
+
+def test_scoped_collectors_isolate_concurrent_contexts():
+    """Two 'requests' recording concurrently on pool threads — each
+    scoped flight recorder must collect ONLY its own spans, counters
+    (canary check) and checkpoint stream, with zero cross-bleed. This is
+    the unit-level contract behind packed proof-parallel recording."""
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    gate = threading.Barrier(2, timeout=30)
+
+    def request(i):
+        with report.flight_recording(label=f"req-{i}", scoped=True) as rec:
+            gate.wait()  # both contexts genuinely record AT THE SAME TIME
+            metrics.count(f"canary.{i}")
+            metrics.count("shared.counter")
+            report.checkpoint(0, "setup_cap", [i])
+            report.checkpoint(1, "witness_cap", [i, i])
+            with spans.span("service_request", request=f"req-{i}"):
+                with spans.span("inner"):
+                    gate.wait()
+        return report.build_report(
+            rec,
+            extra={
+                "request": {
+                    "id": f"req-{i}", "bucket": "n2^10",
+                    "placement": "proof_parallel",
+                    "queue_latency_s": 0.0, "prove_wall_s": 0.01,
+                }
+            },
+        )
+
+    with ThreadPoolExecutor(max_workers=2) as pool:
+        reps = list(pool.map(request, range(2)))
+
+    for i, rep in enumerate(reps):
+        other = 1 - i
+        counters = rep["metrics"]["counters"]
+        assert counters[f"canary.{i}"] == 1
+        assert f"canary.{other}" not in counters, "counter bled"
+        assert counters["shared.counter"] == 1, "shared counter double-counted"
+        digests = [e["digest"] for e in rep["checkpoints"]]
+        assert len(digests) == 2
+        assert digests != [
+            e["digest"] for e in reps[other]["checkpoints"]
+        ], "checkpoint stream bled"
+        names = [sp["name"] for sp in rep["spans"]]
+        assert names == ["service_request"], names
+        assert rep["spans"][0]["attrs"]["request"] == f"req-{i}"
+        # --check level: the line is well-formed and single-request
+        assert report.validate_report(rep) == []
+
+
+def test_scoped_collectors_override_global_default_and_restore():
+    """The process-global default context (bench/CLI posture) keeps
+    working: a scoped context overrides it locally, and recording falls
+    back to the global collectors the moment the scope exits."""
+    rec_global = spans.start_recording()
+    reg_global = metrics.start_metrics()
+    log_global = report.CheckpointLog()
+    prev_log = report.install_checkpoint_log(log_global)
+    try:
+        with spans.span("before_scope"):
+            pass
+        metrics.count("global.counter")
+        report.checkpoint(0, "setup_cap", [1])
+        with report.flight_recording(label="scoped", scoped=True) as rec:
+            with spans.span("scoped_span"):
+                pass
+            metrics.count("scoped.counter")
+            report.checkpoint(0, "setup_cap", [2])
+        with spans.span("after_scope"):
+            pass
+        metrics.count("global.counter")
+    finally:
+        report.install_checkpoint_log(prev_log)
+        metrics.stop_metrics()
+        spans.stop_recording()
+    assert [sp["name"] for sp in rec_global.tree()] == [
+        "before_scope", "after_scope"
+    ]
+    assert reg_global.counters == {"global.counter": 2}
+    assert len(log_global.entries) == 1
+    assert [sp["name"] for sp in rec.spans.tree()] == ["scoped_span"]
+    assert rec.metrics.counters == {"scoped.counter": 1}
+    assert len(rec.checkpoints.entries) == 1
+    # and a thread spawned OUTSIDE any scope sees the global default
+    # (threads start with an empty context -> fallback)
+    import threading
+
+    seen = {}
+
+    def probe():
+        seen["rec"] = spans.current_recorder()
+
+    rec2 = spans.start_recording()
+    try:
+        t = threading.Thread(target=probe)
+        t.start()
+        t.join()
+    finally:
+        spans.stop_recording()
+    assert seen["rec"] is rec2
+
+
+def test_validate_report_rejects_mixed_request_ids():
+    """--check satellite (ISSUE 9): one line carrying spans of TWO
+    request ids means scoped collectors bled across packed requests —
+    the exact corruption the contextvar scoping prevents — and must
+    fail the gate."""
+    base = {
+        "kind": report.REPORT_KIND,
+        "schema": report.REPORT_SCHEMA,
+        "wall_s": 0.5,
+        "spans": [
+            {"name": "service_request", "start_s": 0.0, "wall_s": 0.1,
+             "children": [], "attrs": {"request": "req-1"}},
+        ],
+        "metrics": {"counters": {}},
+        "checkpoints": [],
+        "request": {
+            "id": "req-1", "bucket": "n2^10", "placement": "proof_parallel",
+            "queue_latency_s": 0.0, "prove_wall_s": 0.1,
+        },
+    }
+    assert report.validate_report(base) == []
+    bad = dict(base)
+    bad["spans"] = base["spans"] + [
+        {"name": "service_request", "start_s": 0.2, "wall_s": 0.1,
+         "children": [], "attrs": {"request": "req-2"}},
+    ]
+    probs = report.validate_report(bad)
+    assert any("mixes request ids" in p for p in probs), probs
 
 
 # ---------------------------------------------------------------------------

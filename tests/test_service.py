@@ -550,24 +550,71 @@ def test_e2e_report_check_and_slo():
 
 
 @pytest.mark.slow
-def test_packed_proof_parallel_parity():
-    """max_inflight > 1 with recording OFF packs same-bucket requests
-    one-per-chip (concurrent meshless proves under jax.default_device);
-    proof bytes stay bit-identical to the direct prove. Slow-marked:
-    per-device placement re-traces the kernel library for the second
-    chip (minutes on XLA:CPU), which tier-1's budget cannot absorb."""
+def test_packed_proof_parallel_parity_with_recording(monkeypatch):
+    """Satellite (ISSUE 9): max_inflight=2 packs same-bucket 2^10
+    requests one-per-chip WITH flight recording ON — the combination
+    the process-global collectors used to forbid. Proof bytes AND
+    digest-checkpoint streams stay bit-identical to the sequential
+    direct prove, each packed request writes its own well-formed report
+    line, and a canary counter incremented inside request A's scoped
+    context never appears on request B's line. Slow-marked: per-device
+    placement re-traces the kernel library for the second chip (minutes
+    on XLA:CPU), which tier-1's budget cannot absorb."""
+    import tempfile
+
     from boojum_tpu.service import ProvingService, ServiceConfig
+    from boojum_tpu.utils import metrics as _metrics
 
     runs = _e2e_runs()
-    pa, _ra = runs["direct"]["a"]
+    pa, ra = runs["direct"]["a"]
     asm, setup, cfg = _parts_a()
+    rpt = tempfile.mktemp(suffix=".packed.jsonl")
     svc = ProvingService(
-        ServiceConfig(precompile="off", max_inflight=2, report_path=None)
+        ServiceConfig(precompile="off", max_inflight=2, report_path=rpt)
     )
+    # canary: each request counts a counter named after ITSELF inside
+    # its (scoped) recording window — any cross-request registry bleed
+    # shows up as the other request's canary on this line
+    orig = ProvingService._run_request
+
+    def with_canary(self, req, placement, packed=1, device=None):
+        _metrics.count(f"canary.{req.id}")
+        return orig(self, req, placement, packed=packed, device=device)
+
+    monkeypatch.setattr(ProvingService, "_run_request", with_canary)
     rs = [svc.submit(asm, setup, cfg) for _ in range(2)]
     summary = svc.run_worker()
     assert summary["served"] == 2
     for r in rs:
         assert r.result().to_json() == pa.to_json()
-    assert r.slo["packed"] == 2
+        assert r.slo["packed"] == 2
     assert summary["placements"]["proof_parallel"] == 2
+
+    lines = report.load_reports(rpt)
+    req_lines = [ln for ln in lines if "request" in ln]
+    assert len(req_lines) == 2
+    base = _checkpoint_stream(ra)
+    assert base
+    by_id = {ln["request"]["id"]: ln for ln in req_lines}
+    for r in rs:
+        other = next(o for o in rs if o is not r)
+        ln = by_id[r.id]
+        # bit-identical transcript: the packed request recorded the
+        # SAME checkpoint stream as the sequential direct prove
+        assert _checkpoint_stream(ln) == base, r.id
+        assert report.validate_report(ln) == [], r.id
+        counters = ln["metrics"]["counters"]
+        assert counters.get(f"canary.{r.id}") == 1
+        assert f"canary.{other.id}" not in counters, "counter bled"
+        # exactly ONE prove per line — not its neighbor's too
+        assert counters.get("prover.proves") == 1
+        assert ln["request"]["packed"] == 2
+
+    # the stdlib CLI gate agrees the artifact is clean
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    chk = subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "prove_report.py"),
+         "--check", rpt],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert chk.returncode == 0, chk.stdout + chk.stderr
