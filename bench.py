@@ -485,6 +485,19 @@ def _emit(status):
                 out["span_tree"] = tree
         if _STATE["ntt_eps"] is not None:
             out["ntt_goldilocks_elems_per_s"] = _STATE["ntt_eps"]
+        # which on-device representation ran (ISSUE 10): BENCH_r05+ can
+        # attribute any wall-clock delta to the limb-resident pipeline
+        # (or its absence) straight from the line
+        try:
+            from boojum_tpu.prover.pallas_sweep import (
+                limb_resident_enabled,
+                limb_sweep_enabled,
+            )
+
+            out["limb_resident"] = bool(limb_resident_enabled())
+            out["limb_sweep"] = bool(limb_sweep_enabled())
+        except Exception:
+            pass
         # live-telemetry time series (queue-less in bench, but device
         # memory + live-buffer census over the whole run): the same
         # `telemetry` record the service's report lines carry, so a

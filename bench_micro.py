@@ -103,6 +103,7 @@ def main():
     )
 
     sweep_section(backend)
+    resident_section(backend)
     mesh_section(backend)
 
 
@@ -214,6 +215,115 @@ def sweep_section(backend):
         lambda v, ch, ix: _fold_once_jit(v, ch, ix),
         lambda v, ch, ix: ps.fri_fold(v, ch, ix),
         fold_args, m,
+    )
+
+
+def resident_section(backend):
+    """ISSUE 10 satellite: per-kernel boundary-CONVERTING vs limb-RESIDENT
+    microbench — iNTT, LDE, leaf sponge, gate-terms sweep, FRI fold chain.
+    The converting leg is what each kernel paid before residency (u64 in /
+    u64 out: either emulated-u64 math or the limb kernel plus its
+    boundary split/join); the resident leg consumes and produces (lo, hi)
+    u32 planes end-to-end. Same JSON-line format as the PR 4 `sweep`
+    section. On non-TPU backends the Pallas legs run in interpret mode
+    (correctness smoke more than a perf number)."""
+    from boojum_tpu.field import limbs
+    from boojum_tpu.hashes.poseidon2 import leaf_hash, leaf_hash_planes
+    from boojum_tpu.ntt import limb_ntt as LN
+    from boojum_tpu.ntt import lde_from_monomial, monomial_from_values
+    from boojum_tpu.prover import pallas_sweep as ps
+    from boojum_tpu.prover import resident as RES
+    from boojum_tpu.prover.fri import (
+        _ch_table_np,
+        _fri_fold_fn,
+        _fri_fold_fn_p,
+        fold_challenge_tables,
+        fold_challenge_tables_p,
+    )
+
+    on_tpu = backend == "tpu"
+    log_n = 18 if on_tpu else 10
+    n = 1 << log_n
+    reps = 4 if on_tpu else 2
+    rng = np.random.default_rng(21)
+
+    def rnd(*s):
+        return jnp.asarray(rng.integers(0, gl.P, s, dtype=np.uint64))
+
+    def compare(name, conv_fn, res_fn, conv_args, res_args, elems):
+        dt_c = timed_call(conv_fn, conv_args, reps)
+        dt_r = timed_call(res_fn, res_args, reps)
+        emit(
+            f"resident_{name}_elems_per_s",
+            int(elems / dt_r),
+            "elems/s",
+            converting_elems_per_s=int(elems / dt_c),
+            resident_over_converting=round(dt_c / dt_r, 3),
+            backend=backend,
+            interpret=not on_tpu,
+        )
+
+    # iNTT + LDE (the commit pipeline's transforms)
+    B = 16
+    x = rnd(B, n)
+    xp = limbs.split(x)
+    compare(
+        "imono", monomial_from_values, LN.monomial_from_values_p,
+        (x,), (xp,), B * n,
+    )
+    L = 4
+    compare(
+        "lde",
+        lambda m: lde_from_monomial(m, L),
+        lambda m: LN.lde_from_monomial_p(m, L),
+        (x,), (xp,), B * n * L,
+    )
+
+    # leaf sponge over (N, width) rows
+    leaves = rnd(1 << (14 if on_tpu else 11), 16)
+    leaves_p = limbs.split(leaves)
+    compare(
+        "leaf_sponge", leaf_hash, leaf_hash_planes,
+        (leaves,), (leaves_p,), int(leaves.shape[0]) * 16,
+    )
+
+    # gate-terms sweep (the fused limb kernel: boundary split/join vs
+    # plane-resident in/out; same in-kernel core)
+    from boojum_tpu.cs.gates import FmaGate
+    from boojum_tpu.cs.types import CSGeometry
+
+    geom = CSGeometry(8, 0, 6, 4)
+    gates, paths = (FmaGate.instance(),), ((),)
+    n_terms = FmaGate.instance().num_repetitions(geom)
+    copy, const = rnd(8, n), rnd(6, n)
+    a0 = [int(v) for v in np.asarray(rnd(n_terms))]
+    a1 = [int(v) for v in np.asarray(rnd(n_terms))]
+    gate = ps.gate_terms_fn(gates, paths, geom)
+    table = jnp.asarray(RES.sc_table_np(a0, a1))
+    compare(
+        "gate_terms",
+        lambda c, k: gate(c, None, k, jnp.asarray(np.array(a0, np.uint64)),
+                          jnp.asarray(np.array(a1, np.uint64))),
+        lambda c, k: gate.planes(c, None, k, table),
+        (copy, const), (limbs.split(copy), limbs.split(const)), 8 * n,
+    )
+
+    # FRI fold chain (k=3): the converting chain pays a split+join per
+    # fold; the resident chain stays planes across all three
+    m = 2 * n
+    log_m = m.bit_length() - 1
+    c0, c1 = rnd(m), rnd(m)
+    ch = (3, 5)
+    tabs_u = tuple(fold_challenge_tables(log_m, 3))
+    tabs_p = tuple(fold_challenge_tables_p(log_m, 3))
+    ch01 = jnp.asarray(np.array(ch, dtype=np.uint64))
+    tb = jnp.asarray(_ch_table_np(ch))
+    c0p, c1p = limbs.split(c0), limbs.split(c1)
+    compare(
+        "fri_fold_k3",
+        lambda a, b: _fri_fold_fn(3, True, None)(a, b, ch01, tabs_u),
+        lambda a, b: _fri_fold_fn_p(3, None)(a, b, tb, tabs_p),
+        (c0, c1), (c0p, c1p), m,
     )
 
 

@@ -22,6 +22,7 @@ u64 path — parity is pinned per-op in tests/test_limb_sweep.py.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from . import gl
@@ -174,6 +175,98 @@ def aggregate_columns(cols, table_id_col, gpow, beta):
         acc0 = add(acc0, mul(col, gpow[j][0]))
         acc1 = add(acc1, mul(col, gpow[j][1]))
     return acc0, acc1
+
+
+# ---------------------------------------------------------------------------
+# Inversion (ISSUE 10: the resident prover's denominators/fold tables stay
+# in limb planes end-to-end, so the Montgomery trick needs a limb form).
+# Inverses are unique mod p and every op here is exact+canonical, so values
+# are bit-identical to the u64 goldilocks.batch_inverse family.
+# ---------------------------------------------------------------------------
+
+
+def pow_int(a, e: int):
+    """a ** e for a python-int exponent (square-and-multiply chain)."""
+    e = int(e)
+    assert e >= 0
+    result = None
+    base = a
+    while e:
+        if e & 1:
+            result = base if result is None else mul(result, base)
+        e >>= 1
+        if e:
+            base = sqr(base)
+    if result is None:
+        return ones_like(a)
+    return result
+
+
+def inv(a):
+    """Fermat inverse a^(p-2) on a limb pair; inverse of 0 is 0."""
+    return pow_int(a, gl.P - 2)
+
+
+def prefix_product(a):
+    """Inclusive modular prefix product along the last axis (log-doubling
+    Hillis–Steele, the goldilocks.prefix_product twin on planes)."""
+    lo, hi = a
+    n = lo.shape[-1]
+    shift = 1
+    while shift < n:
+        pad_lo = jnp.ones(lo.shape[:-1] + (shift,), jnp.uint32)
+        pad_hi = jnp.zeros(hi.shape[:-1] + (shift,), jnp.uint32)
+        shifted = (
+            jnp.concatenate([pad_lo, lo[..., :-shift]], axis=-1),
+            jnp.concatenate([pad_hi, hi[..., :-shift]], axis=-1),
+        )
+        lo, hi = mul((lo, hi), shifted)
+        shift *= 2
+    return lo, hi
+
+
+def batch_inverse(a):
+    """Montgomery batch inversion along the last axis on limb planes
+    (two prefix-product passes + ONE Fermat inversion)."""
+    lo, hi = a
+    prefix = prefix_product(a)
+    total_inv = inv((prefix[0][..., -1:], prefix[1][..., -1:]))
+    rev = (jnp.flip(lo, axis=-1), jnp.flip(hi, axis=-1))
+    rev_prefix = prefix_product(rev)
+    suffix = (
+        jnp.concatenate(
+            [jnp.flip(rev_prefix[0][..., :-1], axis=-1),
+             jnp.ones_like(lo[..., :1])], axis=-1,
+        ),
+        jnp.concatenate(
+            [jnp.flip(rev_prefix[1][..., :-1], axis=-1),
+             jnp.zeros_like(hi[..., :1])], axis=-1,
+        ),
+    )
+    shifted_prefix = (
+        jnp.concatenate(
+            [jnp.ones_like(lo[..., :1]), prefix[0][..., :-1]], axis=-1
+        ),
+        jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), prefix[1][..., :-1]], axis=-1
+        ),
+    )
+    return mul(mul(total_inv, suffix), shifted_prefix)
+
+
+def ext_batch_inverse(a):
+    """GF(p^2) batch inversion on ext limb elements (extension.batch_inverse
+    twin): 1/(c0 + c1 w) = (c0 - c1 w) / (c0² - 7 c1²)."""
+    d = sub(sqr(a[0]), mul_small(sqr(a[1]), NON_RESIDUE))
+    dinv = batch_inverse(d)
+    return mul(a[0], dinv), neg(mul(a[1], dinv))
+
+
+# top-level jit boundaries for the inversions (same posture as
+# goldilocks.batch_inverse / extension.batch_inverse: the Fermat chain
+# inlined into large XLA:CPU modules has miscompiled — keep it separate)
+batch_inverse_jit = jax.jit(batch_inverse)
+ext_batch_inverse_jit = jax.jit(ext_batch_inverse)
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,9 @@ integer multiplier returns only the low 32 bits.
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -36,10 +39,49 @@ EPS = np.uint32(0xFFFFFFFF)  # 2^32 - 1 == 2^64 mod p (fits one limb)
 # ---------------------------------------------------------------------------
 # u64 <-> limb conversions (run OUTSIDE kernels, plain XLA)
 # ---------------------------------------------------------------------------
+# Every device-side conversion is charged to the metrics registry (ISSUE 10):
+# `limb.splits` / `limb.joins` are the INTERIOR boundary tax the resident
+# mode exists to delete; conversions wrapped in `edge(label)` are the
+# allowlisted API-edge set (H2D/setup ingest, transcript absorbs, query
+# openings, proof serialization) and count as `limb.edge_splits` /
+# `limb.edge_joins` instead. The guard test (tests/test_limb_resident.py)
+# pins a resident prove at ZERO interior conversions. Counters tick at
+# trace time for jitted graphs — exactly when a conversion enters a
+# compiled module — and at call time for eager ops; both are what "this
+# graph contains a conversion" means.
+
+_EDGE_LABEL: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "boojum_tpu.limb_edge", default=None
+)
+
+
+@contextlib.contextmanager
+def edge(label: str):
+    """Mark enclosed split/join calls as allowlisted edge conversions."""
+    token = _EDGE_LABEL.set(str(label))
+    try:
+        yield
+    finally:
+        _EDGE_LABEL.reset(token)
+
+
+def edge_label() -> str | None:
+    return _EDGE_LABEL.get()
+
+
+def _charge(kind: str):
+    from ..utils import metrics as _metrics
+
+    lbl = _EDGE_LABEL.get()
+    if lbl is None:
+        _metrics.count(f"limb.{kind}s")
+    else:
+        _metrics.count(f"limb.edge_{kind}s")
 
 
 def split(x: jax.Array):
     """uint64 array -> (lo, hi) uint32 pair."""
+    _charge("split")
     return (
         (x & jnp.uint64(0xFFFFFFFF)).astype(_u32),
         (x >> jnp.uint64(32)).astype(_u32),
@@ -48,6 +90,7 @@ def split(x: jax.Array):
 
 def join(pair) -> jax.Array:
     """(lo, hi) uint32 pair -> uint64 array."""
+    _charge("join")
     lo, hi = pair
     return lo.astype(jnp.uint64) | (hi.astype(jnp.uint64) << jnp.uint64(32))
 
@@ -59,11 +102,27 @@ def const_pair(value: int):
 
 
 def split_np(x: np.ndarray):
-    """Host-side split for precomputed tables."""
+    """Host-side split for precomputed tables (never a device op; counted
+    separately so the residency guard can tell host edges from interior
+    device conversions)."""
+    from ..utils import metrics as _metrics
+
+    _metrics.count("limb.host_splits")
     x = np.asarray(x, dtype=np.uint64)
     return (
         (x & np.uint64(0xFFFFFFFF)).astype(np.uint32),
         (x >> np.uint64(32)).astype(np.uint32),
+    )
+
+
+def join_np(lo, hi) -> np.ndarray:
+    """Host-side join (query openings / transcript pulls land here: the
+    resident prover fetches u32 planes and reassembles u64 on host)."""
+    from ..utils import metrics as _metrics
+
+    _metrics.count("limb.host_joins")
+    return np.asarray(lo, dtype=np.uint64) | (
+        np.asarray(hi, dtype=np.uint64) << np.uint64(32)
     )
 
 

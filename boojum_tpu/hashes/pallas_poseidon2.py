@@ -30,21 +30,32 @@ import numpy as np
 from ..field import limbs
 from . import poseidon2_params as params
 
-_RC = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
-_DIAG = np.array(params.M_I_DIAGONAL, dtype=np.uint64)
-
-# (30, 12) limb pairs -> (30, 24) u32: [lo(12) | hi(12)] per round, plus a
-# 31st row carrying the M_I diagonal in the same [lo | hi] layout — pallas
-# kernels cannot close over array constants, so the diagonal rides the same
-# SMEM table as the round constants
-_RC_U32 = np.concatenate(
-    [
-        np.concatenate(limbs.split_np(_RC), axis=1),
-        np.concatenate(limbs.split_np(_DIAG[None, :]), axis=1),
-    ],
-    axis=0,
-)
 _DIAG_ROW = 30
+
+from functools import lru_cache as _lru_cache  # noqa: E402
+
+
+@_lru_cache(maxsize=None)
+def rc_diag_table(layout: str = "lohi24") -> np.ndarray:
+    """RC/DIAG limb constants in one kernel-variant-keyed spec cache.
+
+    (30, 12) limb pairs -> (30, 24) u32: [lo(12) | hi(12)] per round, plus
+    a 31st row carrying the M_I diagonal in the same [lo | hi] layout —
+    pallas kernels cannot close over array constants, so the diagonal
+    rides the same SMEM table as the round constants. Built at first
+    kernel build (NOT import time) and keyed by the variant's constant
+    layout, so the resident and converting kernel variants can never
+    share a stale layout (ISSUE 10 satellite)."""
+    assert layout == "lohi24", layout
+    rc = np.array(params.ALL_ROUND_CONSTANTS, dtype=np.uint64).reshape(30, 12)
+    diag = np.array(params.M_I_DIAGONAL, dtype=np.uint64)
+    return np.concatenate(
+        [
+            np.concatenate(limbs.split_np(rc), axis=1),
+            np.concatenate(limbs.split_np(diag[None, :]), axis=1),
+        ],
+        axis=0,
+    )
 
 
 def _sbox7(x):
@@ -214,7 +225,7 @@ def _permute_planes(lo, hi, tile_rows: int, interpret: bool):
         out_specs=[spec, spec],
         interpret=interpret,
         compiler_params=None if interpret else _CP,
-    )(jnp.asarray(_RC_U32), lo, hi)
+    )(jnp.asarray(rc_diag_table()), lo, hi)
 
 
 @partial(jax.jit, static_argnums=(2, 3, 4))
@@ -241,7 +252,7 @@ def _sponge_planes(vlo, vhi, num_chunks: int, tile_rows: int, interpret: bool):
         out_specs=[out_spec, out_spec],
         interpret=interpret,
         compiler_params=None if interpret else _CP,
-    )(jnp.asarray(_RC_U32), vlo, vhi)
+    )(jnp.asarray(rc_diag_table()), vlo, vhi)
 
 
 # tile legality (divisor-of-R, multiple-of-8 sublane rule) is shared with
@@ -259,31 +270,39 @@ def batch_fits(n: int) -> bool:
     return n >= _MIN_BATCH and n % (8 * _LANE) == 0
 
 
-def permutation(state: jax.Array, interpret: bool = False) -> jax.Array:
-    """Batched Poseidon2 permutation on (N, 12) uint64, N = R*128."""
-    n = state.shape[0]
+# The kernels' NATIVE interface takes (lo, hi) u32 planes directly (ISSUE
+# 10: the former u64 wrappers' split/join at every call were the interior
+# boundary tax the resident mode deletes); `permutation`/`sponge_hash`
+# survive as thin u64 conversion shims for the converting path.
+
+
+def permutation_planes(state_p, interpret: bool = False):
+    """Batched Poseidon2 permutation on (N, 12) u32 limb planes."""
+    slo, shi = state_p
+    n = slo.shape[0]
     assert n % _LANE == 0
     R = n // _LANE
-    # (N, 12) -> (12, R, 128) limb planes
-    planes = state.T.reshape(12, R, _LANE)
-    lo, hi = limbs.split(planes)
+    # (N, 12) -> (12, R, 128) plane layout
+    lo = slo.T.reshape(12, R, _LANE)
+    hi = shi.T.reshape(12, R, _LANE)
     tile = _pick_tile(R, 16)
     olo, ohi = _permute_planes(lo, hi, tile, interpret)
-    out = limbs.join((olo, ohi))
-    return out.reshape(12, n).T
+    return olo.reshape(12, n).T, ohi.reshape(12, n).T
 
 
-def sponge_hash(values: jax.Array, interpret: bool = False) -> jax.Array:
-    """(N, L) uint64 leaf values -> (N, 4) digests (overwrite-mode sponge)."""
-    n, L = values.shape
+def sponge_hash_planes(values_p, interpret: bool = False):
+    """(N, L) leaf-value planes -> (N, 4) digest planes (overwrite mode)."""
+    vlo0, vhi0 = values_p
+    n, L = vlo0.shape
     assert n % _LANE == 0
     num_chunks = max(1, (L + 7) // 8)
     R = n // _LANE
-    planes = values.T.reshape(L, R, _LANE)
+    vlo = vlo0.T.reshape(L, R, _LANE)
+    vhi = vhi0.T.reshape(L, R, _LANE)
     if L < 8 * num_chunks:
-        pad = jnp.zeros((8 * num_chunks - L, R, _LANE), values.dtype)
-        planes = jnp.concatenate([planes, pad], axis=0)
-    vlo, vhi = limbs.split(planes)
+        pad = jnp.zeros((8 * num_chunks - L, R, _LANE), jnp.uint32)
+        vlo = jnp.concatenate([vlo, pad], axis=0)
+        vhi = jnp.concatenate([vhi, pad], axis=0)
     # VMEM budget: (L + out + temps) * tile * 128 * 4B * 2 planes. Floor at
     # 8 (the minimum legal sublane tile): wide leaves simply use more VMEM
     # per step — the raised compiler vmem cap covers L up to ~1024, and the
@@ -291,5 +310,16 @@ def sponge_hash(values: jax.Array, interpret: bool = False) -> jax.Array:
     budget = max(8, (2 << 20) // max(8 * num_chunks * _LANE * 8, 1))
     tile = _pick_tile(R, budget)
     olo, ohi = _sponge_planes(vlo, vhi, num_chunks, tile, interpret)
-    out = limbs.join((olo, ohi))
-    return out.reshape(4, n).T
+    return olo.reshape(4, n).T, ohi.reshape(4, n).T
+
+
+def permutation(state: jax.Array, interpret: bool = False) -> jax.Array:
+    """u64 shim over `permutation_planes` (converting path only)."""
+    out = permutation_planes(limbs.split(state), interpret)
+    return limbs.join(out)
+
+
+def sponge_hash(values: jax.Array, interpret: bool = False) -> jax.Array:
+    """u64 shim over `sponge_hash_planes` (converting path only)."""
+    out = sponge_hash_planes(limbs.split(values), interpret)
+    return limbs.join(out)

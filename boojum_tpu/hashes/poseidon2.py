@@ -148,6 +148,120 @@ def node_hash_xla(left: jax.Array, right: jax.Array) -> jax.Array:
 
 
 # ---------------------------------------------------------------------------
+# Limb-plane forms (ISSUE 10): the SAME sponge semantics over (lo, hi) u32
+# plane pairs in the u64 layouts — the resident prover's hashing never
+# leaves the plane representation. The XLA bodies reuse the fused kernel's
+# limb round functions (pallas_poseidon2._permutation_planes_stacked) as
+# plain jnp, so there is exactly one limb implementation of the rounds.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def poseidon2_permutation_planes_xla(state_p):
+    """Batched permutation on (..., 12) limb planes (XLA path)."""
+    from . import pallas_poseidon2 as pp2
+
+    rc = jnp.asarray(pp2.rc_diag_table())
+    lo = jnp.moveaxis(state_p[0], -1, 0)
+    hi = jnp.moveaxis(state_p[1], -1, 0)
+    olo, ohi = pp2._permutation_planes_stacked(rc, lo, hi)
+    return jnp.moveaxis(olo, 0, -1), jnp.moveaxis(ohi, 0, -1)
+
+
+def _sponge_hash_planes_device(values_p, permutation_p):
+    """Overwrite-mode sponge over (..., L) planes -> (..., 4) planes
+    (the `_sponge_hash_device` twin, same chunk/finalize semantics)."""
+    vlo, vhi = values_p
+    lead = vlo.shape[:-1]
+    L = vlo.shape[-1]
+    state = (
+        jnp.zeros(lead + (12,), jnp.uint32),
+        jnp.zeros(lead + (12,), jnp.uint32),
+    )
+    full = L // 8
+
+    def _absorb(c, st):
+        clo = jax.lax.dynamic_slice_in_dim(vlo, 8 * c, 8, axis=-1)
+        chi = jax.lax.dynamic_slice_in_dim(vhi, 8 * c, 8, axis=-1)
+        st = (
+            jnp.concatenate([clo, st[0][..., 8:]], axis=-1),
+            jnp.concatenate([chi, st[1][..., 8:]], axis=-1),
+        )
+        return permutation_p(st)
+
+    if full > 0:
+        state = jax.lax.fori_loop(0, full, _absorb, state)
+    rem = L - 8 * full
+    if rem > 0:
+        pad = jnp.zeros(lead + (8 - rem,), jnp.uint32)
+        state = (
+            jnp.concatenate(
+                [vlo[..., 8 * full :], pad, state[0][..., 8:]], axis=-1
+            ),
+            jnp.concatenate(
+                [vhi[..., 8 * full :], pad, state[1][..., 8:]], axis=-1
+            ),
+        )
+        state = permutation_p(state)
+    return state[0][..., :4], state[1][..., :4]
+
+
+@jax.jit
+def leaf_hash_planes_xla(values_p):
+    return _sponge_hash_planes_device(
+        values_p, poseidon2_permutation_planes_xla
+    )
+
+
+@jax.jit
+def node_hash_planes_xla(left_p, right_p):
+    z = jnp.zeros(left_p[0].shape[:-1] + (4,), jnp.uint32)
+    state = (
+        jnp.concatenate([left_p[0], right_p[0], z], axis=-1),
+        jnp.concatenate([left_p[1], right_p[1], z], axis=-1),
+    )
+    out = poseidon2_permutation_planes_xla(state)
+    return out[0][..., :4], out[1][..., :4]
+
+
+def poseidon2_permutation_planes(state_p):
+    """Plane twin of `poseidon2_permutation` (fused kernel on TPU)."""
+    if state_p[0].ndim == 2 and _pallas_ready(state_p[0].shape[0]):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.permutation_planes(state_p)
+    return poseidon2_permutation_planes_xla(state_p)
+
+
+def leaf_hash_planes(values_p):
+    """Plane twin of `leaf_hash`: (N, L) planes -> (N, 4) digest planes."""
+    vlo = values_p[0]
+    if (
+        vlo.ndim == 2
+        and vlo.shape[1] <= 1024
+        and _pallas_ready(vlo.shape[0])
+    ):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.sponge_hash_planes(values_p)
+    return leaf_hash_planes_xla(values_p)
+
+
+def node_hash_planes(left_p, right_p):
+    """Plane twin of `node_hash`."""
+    if left_p[0].ndim == 2 and _pallas_ready(left_p[0].shape[0]):
+        from . import pallas_poseidon2 as pp2
+
+        return pp2.sponge_hash_planes(
+            (
+                jnp.concatenate([left_p[0], right_p[0]], axis=-1),
+                jnp.concatenate([left_p[1], right_p[1]], axis=-1),
+            )
+        )
+    return node_hash_planes_xla(left_p, right_p)
+
+
+# ---------------------------------------------------------------------------
 # Dispatchers: fused Pallas kernels on TPU, XLA everywhere else. Results are
 # bit-identical (tests/test_pallas_kernels.py asserts parity).
 # ---------------------------------------------------------------------------

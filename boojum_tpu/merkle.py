@@ -18,7 +18,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .hashes.poseidon2 import leaf_hash, node_hash, Poseidon2SpongeHost
+from .field import limbs as _limbs
+from .hashes.poseidon2 import (
+    Poseidon2SpongeHost,
+    leaf_hash,
+    leaf_hash_planes,
+    node_hash,
+    node_hash_planes,
+)
 from .parallel.sharding import host_np as _host_np
 from .utils import metrics as _metrics
 
@@ -98,6 +105,123 @@ def commit_layers_device(lde_cols, cap_size: int):
     shape-keyed dispatches: leaf sponge + shared node stack."""
     _metrics.count("merkle.commit_layer_builds")
     return node_layers_device(leaf_digests_device(lde_cols), cap_size)
+
+
+# ---------------------------------------------------------------------------
+# Limb-plane commit kernels + tree (ISSUE 10): digests stay (lo, hi) u32
+# plane pairs on device end-to-end; u64 exists only on HOST — the cap join
+# and query-path joins happen in numpy at the transcript/query API edge.
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def leaf_digests_planes(lde_p):
+    """Plane twin of leaf_digests_device: (B, ...) column planes ->
+    (N, 4) digest planes, one dispatch."""
+    lo, hi = lde_p
+    B = lo.shape[0]
+    return leaf_hash_planes((lo.reshape(B, -1).T, hi.reshape(B, -1).T))
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _tree_tail_layers_planes(digests_p, cap_size: int):
+    layers = []
+    cur = digests_p
+    while cur[0].shape[0] > cap_size:
+        cur = node_hash_planes(
+            (cur[0][0::2], cur[1][0::2]), (cur[0][1::2], cur[1][1::2])
+        )
+        layers.append(cur)
+    return tuple(layers)
+
+
+def _node_layers_planes_body(digests_p, cap_size: int):
+    layers = [digests_p]
+    while (
+        layers[-1][0].shape[0] > cap_size
+        and layers[-1][0].shape[0] > _FUSE_THRESHOLD
+    ):
+        cur = layers[-1]
+        layers.append(
+            node_hash_planes(
+                (cur[0][0::2], cur[1][0::2]), (cur[0][1::2], cur[1][1::2])
+            )
+        )
+    if layers[-1][0].shape[0] > cap_size:
+        layers.extend(_tree_tail_layers_planes(layers[-1], cap_size))
+    return tuple(layers)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def node_layers_planes(digests_p, cap_size: int):
+    """Plane twin of node_layers_device (same shared-executable keying)."""
+    return _node_layers_planes_body(digests_p, cap_size)
+
+
+def commit_layers_planes(lde_p, cap_size: int):
+    """Plane twin of commit_layers_device."""
+    _metrics.count("merkle.commit_layer_builds")
+    return node_layers_planes(leaf_digests_planes(lde_p), cap_size)
+
+
+def _cap_host_from_planes(cap_p):
+    cap = _limbs.join_np(_host_np(cap_p[0]), _host_np(cap_p[1]))
+    return [tuple(int(x) for x in row) for row in cap]
+
+
+class PlaneMerkleTree:
+    """MerkleTreeWithCap twin whose digest layers stay u32 plane pairs.
+
+    Caps and query paths leave the device as planes and join on HOST
+    (numpy) — the representation's API edge. Digest VALUES are identical
+    to the u64 tree's, so transcripts and proofs are unchanged."""
+
+    @classmethod
+    def from_layers(cls, layers, cap_size: int) -> "PlaneMerkleTree":
+        tree = cls.__new__(cls)
+        tree.cap_size = cap_size
+        tree.num_leaves = int(layers[0][0].shape[0])
+        _metrics.count("merkle.tree_builds")
+        _metrics.count("merkle.plane_tree_builds")
+        tree.layers = list(layers)
+        tree._cap_host = _cap_host_from_planes(tree.layers[-1])
+        return tree
+
+    @classmethod
+    def from_digests(cls, digests_p, cap_size: int) -> "PlaneMerkleTree":
+        n = int(digests_p[0].shape[0])
+        assert n & (n - 1) == 0 and cap_size & (cap_size - 1) == 0
+        assert n >= cap_size
+        return cls.from_layers(
+            list(node_layers_planes(digests_p, cap_size)), cap_size
+        )
+
+    def get_cap(self):
+        return list(self._cap_host)
+
+    def proof_gather_plans(self, leaf_indices):
+        """Like MerkleTreeWithCap.proof_gather_plans, but each level
+        contributes TWO plans (lo, hi); assemble() joins pairs on host."""
+        idxs = np.array(list(leaf_indices), dtype=np.int64)
+        plans = []
+        cur = idxs
+        for lo, hi in self.layers[:-1]:
+            sib = cur ^ 1
+            plans.append((lo, sib))
+            plans.append((hi, sib))
+            cur = cur >> 1
+
+        def assemble(levels):
+            joined = [
+                _limbs.join_np(levels[2 * i], levels[2 * i + 1])
+                for i in range(len(levels) // 2)
+            ]
+            return [
+                [tuple(int(x) for x in level[q]) for level in joined]
+                for q in range(len(idxs))
+            ]
+
+        return plans, assemble
 
 
 class MerkleTreeWithCap:

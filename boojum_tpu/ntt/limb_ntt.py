@@ -1,0 +1,384 @@
+"""Limb-plane NTT / coset LDE: the resident-mode transform layer (ISSUE 10).
+
+`ntt.py` computes in XLA-emulated uint64 and `mxu_ntt.py` converts u64->limb
+planes at every public entry — which is exactly the boundary tax the
+limb-resident prove deletes. This module is the transform layer whose
+CANONICAL representation is a `(lo, hi)` uint32 plane pair shaped like the
+u64 array it replaces:
+
+- twiddle/scale tables are built on HOST (numpy `_powers_np` + `split_np`),
+  so no device-side u64<->limb conversion exists anywhere in the layer;
+- the staged radix-2 butterflies are `field/limbs.py` ops (exact mod p,
+  canonical in/out), so every value is bit-identical to the u64 path;
+- where the MXU matmul kernel is native (TPU, 2^14..2^22), the plane entries
+  feed `mxu_ntt._fft_planes/_ifft_planes/_lde_planes` DIRECTLY — the
+  split/join wrappers of `mxu_ntt`'s u64 entries never run.
+
+Layout convention: same shapes as the u64 arrays, as a pair of uint32
+arrays. Big column batches chunk exactly like `ntt.monomial_from_values` /
+`lde_from_monomial` (shared `_col_chunks`), writing into two donated u32
+buffers.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import gl
+from ..field import limbs
+
+from .ntt import (
+    _col_chunks,
+    _mxu_ntt_ready,
+    _powers_np,
+    bitreverse_indices,
+)
+
+
+@lru_cache(maxsize=None)
+class PlaneNTTContext:
+    """Host-built twiddle planes for size-2^log_n transforms."""
+
+    def __init__(self, log_n: int):
+        self.log_n = log_n
+        self.n = 1 << log_n
+        self.omega = gl.omega(log_n)
+        half = max(self.n // 2, 1)
+        with jax.ensure_compile_time_eval():
+            tw_lo, tw_hi = limbs.split_np(_powers_np(self.omega, half))
+            itw_lo, itw_hi = limbs.split_np(
+                _powers_np(gl.inv(self.omega), half)
+            )
+            self.tw = (jnp.asarray(tw_lo), jnp.asarray(tw_hi))
+            self.itw = (jnp.asarray(itw_lo), jnp.asarray(itw_hi))
+            self.brev = jnp.asarray(bitreverse_indices(log_n))
+        self.n_inv = limbs.const_pair(gl.inv(self.n))
+
+
+def _tw_slice(tw, n, block, half):
+    if half > 1:
+        return tw[0][:: n // block][:half], tw[1][:: n // block][:half]
+    return tw[0][:1], tw[1][:1]
+
+
+def dif_stages_p(p, ctx: PlaneNTTContext, start: int, end: int):
+    """Radix-2 DIF stages [start, end) on planes (ntt.dif_stages twin)."""
+    n = ctx.n
+    lo, hi = p
+    lead = lo.shape[:-1]
+    for s in range(start, end):
+        block = n >> s
+        half = block >> 1
+        tw = _tw_slice(ctx.tw, n, block, half)
+        xl = lo.reshape(lead + (n // block, 2, half))
+        xh = hi.reshape(lead + (n // block, 2, half))
+        u = (xl[..., 0, :], xh[..., 0, :])
+        v = (xl[..., 1, :], xh[..., 1, :])
+        top = limbs.add(u, v)
+        bot = limbs.mul(limbs.sub(u, v), tw)
+        lo = jnp.stack([top[0], bot[0]], axis=-2).reshape(lead + (n,))
+        hi = jnp.stack([top[1], bot[1]], axis=-2).reshape(lead + (n,))
+    return lo, hi
+
+
+def dit_stages_p(p, ctx: PlaneNTTContext, start: int, end: int):
+    """Radix-2 DIT stages [start, end) on planes (no 1/n scaling)."""
+    n = ctx.n
+    lo, hi = p
+    lead = lo.shape[:-1]
+    for s in range(start, end):
+        block = 2 << s
+        half = block >> 1
+        tw = _tw_slice(ctx.itw, n, block, half)
+        xl = lo.reshape(lead + (n // block, 2, half))
+        xh = hi.reshape(lead + (n // block, 2, half))
+        u = (xl[..., 0, :], xh[..., 0, :])
+        wv = limbs.mul((xl[..., 1, :], xh[..., 1, :]), tw)
+        top = limbs.add(u, wv)
+        bot = limbs.sub(u, wv)
+        lo = jnp.stack([top[0], bot[0]], axis=-2).reshape(lead + (n,))
+        hi = jnp.stack([top[1], bot[1]], axis=-2).reshape(lead + (n,))
+    return lo, hi
+
+
+# ---------------------------------------------------------------------------
+# Staged-XLA plane transforms (jitted entries)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _fft_p_jit(p):
+    n = p[0].shape[-1]
+    log_n = n.bit_length() - 1
+    ctx = PlaneNTTContext(log_n)
+    return dif_stages_p(p, ctx, 0, log_n)
+
+
+@jax.jit
+def _ifft_p_jit(p):
+    n = p[0].shape[-1]
+    log_n = n.bit_length() - 1
+    ctx = PlaneNTTContext(log_n)
+    return limbs.mul_const(dit_stages_p(p, ctx, 0, log_n), ctx.n_inv)
+
+
+@jax.jit
+def _imono_p_jit(p):
+    """Values over H (natural) -> monomials, on planes."""
+    n = p[0].shape[-1]
+    ctx = PlaneNTTContext(n.bit_length() - 1)
+    p = (p[0][..., ctx.brev], p[1][..., ctx.brev])
+    return limbs.mul_const(dit_stages_p(p, ctx, 0, ctx.log_n), ctx.n_inv)
+
+
+@lru_cache(maxsize=None)
+def _lde_scale_planes(log_n: int, lde_factor: int, coset: int):
+    """Host-built (lde, n) coset-scale planes (ntt._lde_scale_cached twin)."""
+    n = 1 << log_n
+    log_lde = lde_factor.bit_length() - 1
+    w_full = gl.omega(log_n + log_lde)
+    brev_lde = bitreverse_indices(log_lde)
+    shifts = [
+        gl.mul(coset % gl.P, gl.pow_(w_full, int(j))) for j in brev_lde
+    ]
+    with jax.ensure_compile_time_eval():
+        lo, hi = limbs.split_np(np.stack([_powers_np(s, n) for s in shifts]))
+        return jnp.asarray(lo), jnp.asarray(hi)
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _lde_p_jit(p, lde_factor: int, coset: int):
+    n = p[0].shape[-1]
+    log_n = n.bit_length() - 1
+    scale = _lde_scale_planes(log_n, lde_factor, coset)
+    scaled = limbs.mul((p[0][..., None, :], p[1][..., None, :]), scale)
+    return _fft_body(scaled)
+
+
+def _fft_body(p):
+    n = p[0].shape[-1]
+    log_n = n.bit_length() - 1
+    return dif_stages_p(p, PlaneNTTContext(log_n), 0, log_n)
+
+
+# ---------------------------------------------------------------------------
+# MXU dispatch + hybrid sizes
+# ---------------------------------------------------------------------------
+
+
+def _mxu_fft_p(p, inverse: bool):
+    from . import mxu_ntt
+
+    n = p[0].shape[-1]
+    log_n = n.bit_length() - 1
+    if log_n > mxu_ntt.MAX_LOG_N:
+        return _hybrid_p(p, log_n, inverse)
+    ctx = mxu_ntt.get_mxu_ctx(log_n)
+    lead = p[0].shape[:-1]
+    flat = (p[0].reshape(-1, ctx.R, ctx.C), p[1].reshape(-1, ctx.R, ctx.C))
+    fn = mxu_ntt._ifft_planes if inverse else mxu_ntt._fft_planes
+    out = fn(flat, log_n, False)
+    return out[0].reshape(lead + (n,)), out[1].reshape(lead + (n,))
+
+
+@partial(jax.jit, static_argnums=(1, 2))
+def _hybrid_p(p, log_n: int, inverse: bool):
+    """2^17..2^22: plane XLA outer radix-2 stages + per-block MXU kernels
+    (mxu_ntt._fft_hybrid/_ifft_hybrid twins)."""
+    from . import mxu_ntt
+
+    n = 1 << log_n
+    outer = log_n - mxu_ntt.MAX_LOG_N
+    ctx = PlaneNTTContext(log_n)
+    lead = p[0].shape[:-1]
+    if not inverse:
+        p = dif_stages_p(p, ctx, 0, outer)
+        blocks = (
+            p[0].reshape(lead + (1 << outer, 1 << mxu_ntt.MAX_LOG_N)),
+            p[1].reshape(lead + (1 << outer, 1 << mxu_ntt.MAX_LOG_N)),
+        )
+        out = _mxu_fft_p(blocks, False)
+        return out[0].reshape(lead + (n,)), out[1].reshape(lead + (n,))
+    blocks = (
+        p[0].reshape(lead + (1 << outer, 1 << mxu_ntt.MAX_LOG_N)),
+        p[1].reshape(lead + (1 << outer, 1 << mxu_ntt.MAX_LOG_N)),
+    )
+    out = _mxu_fft_p(blocks, True)
+    out = (
+        out[0].reshape(lead + (n,)),
+        out[1].reshape(lead + (n,)),
+    )
+    out = dit_stages_p(out, ctx, mxu_ntt.MAX_LOG_N, log_n)
+    return limbs.mul_const(out, limbs.const_pair(gl.inv(1 << outer)))
+
+
+def fft_natural_to_bitreversed_p(p):
+    """DIF NTT on planes along the last axis (bit-reversed output)."""
+    if _mxu_ntt_ready(int(p[0].shape[-1]), None):
+        return _mxu_fft_p(p, False)
+    return _fft_p_jit(p)
+
+
+def ifft_bitreversed_to_natural_p(p):
+    """DIT inverse NTT on planes (incl. 1/n)."""
+    if _mxu_ntt_ready(int(p[0].shape[-1]), None):
+        return _mxu_fft_p(p, True)
+    return _ifft_p_jit(p)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def distribute_powers_p(p, base: int):
+    """p[..., i] *= base^i on planes (host-built scale table)."""
+    n = p[0].shape[-1]
+    with jax.ensure_compile_time_eval():
+        lo, hi = limbs.split_np(_powers_np(int(base) % gl.P, n))
+        scale = (jnp.asarray(lo), jnp.asarray(hi))
+    return limbs.mul(p, scale)
+
+
+# ---------------------------------------------------------------------------
+# Chunked public entries (monomial_from_values / lde_from_monomial twins)
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, donate_argnums=(0, 1), static_argnums=(4,))
+def _write_block_p(buf_lo, buf_hi, chunk_lo, chunk_hi, i: int):
+    return (
+        jax.lax.dynamic_update_slice_in_dim(buf_lo, chunk_lo, i, axis=0),
+        jax.lax.dynamic_update_slice_in_dim(buf_hi, chunk_hi, i, axis=0),
+    )
+
+
+def _assemble_chunks_p(shape, produce, starts):
+    out_lo = jnp.zeros(shape, jnp.uint32)
+    out_hi = jnp.zeros(shape, jnp.uint32)
+    for i in starts:
+        clo, chi = produce(i)
+        out_lo, out_hi = _write_block_p(out_lo, out_hi, clo, chi, i)
+    return out_lo, out_hi
+
+
+def monomial_from_values_p(p):
+    """Values over H -> monomial coefficients, on planes (chunked)."""
+    lo, hi = p
+    if lo.ndim < 2:
+        return _imono_p_jit(p)
+    B = lo.shape[0]
+    per = _col_chunks(B, lo.size // B * 8)
+    if per is None:
+        return _imono_p_jit(p)
+    return _assemble_chunks_p(
+        lo.shape,
+        lambda i: _imono_p_jit((lo[i : i + per], hi[i : i + per])),
+        range(0, B, per),
+    )
+
+
+def _lde_one_p(p, lde_factor: int, coset: int):
+    n = int(p[0].shape[-1])
+    if _mxu_ntt_ready(n, None):
+        from . import mxu_ntt
+
+        log_n = n.bit_length() - 1
+        if log_n > mxu_ntt.MAX_LOG_N:
+            scale = _lde_scale_planes(log_n, lde_factor, coset)
+            scaled = limbs.mul(
+                (p[0][..., None, :], p[1][..., None, :]), scale
+            )
+            return _mxu_fft_p(scaled, False)
+        ctx = mxu_ntt.get_mxu_ctx(log_n)
+        lead = p[0].shape[:-1]
+        flat = (
+            p[0].reshape(-1, ctx.R, ctx.C),
+            p[1].reshape(-1, ctx.R, ctx.C),
+        )
+        scale = _lde_scale_planes(log_n, lde_factor, coset)
+        s_planes = (
+            scale[0].reshape(lde_factor, ctx.R, ctx.C),
+            scale[1].reshape(lde_factor, ctx.R, ctx.C),
+        )
+        out = mxu_ntt._lde_planes(flat, s_planes, log_n, False)
+        return (
+            out[0].reshape(lead + (lde_factor, n)),
+            out[1].reshape(lead + (lde_factor, n)),
+        )
+    return _lde_p_jit(p, lde_factor, coset)
+
+
+def lde_from_monomial_p(
+    p, lde_factor: int, coset: int = int(gl.MULTIPLICATIVE_GENERATOR)
+):
+    """Monomial planes (..., n) -> (..., lde_factor, n) LDE planes."""
+    coset = int(coset) % gl.P
+    lo, hi = p
+    n = lo.shape[-1]
+    if lo.ndim < 2:
+        return _lde_one_p(p, lde_factor, coset)
+    B = lo.shape[0]
+    per = _col_chunks(B, lo.size // B * 8 * lde_factor)
+    if per is None:
+        return _lde_one_p(p, lde_factor, coset)
+    return _assemble_chunks_p(
+        lo.shape[:-1] + (lde_factor, n),
+        lambda i: _lde_one_p(
+            (lo[i : i + per], hi[i : i + per]), lde_factor, coset
+        ),
+        range(0, B, per),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Precompile enumeration (ntt.ntt_kernel_specs twin, resident names)
+# ---------------------------------------------------------------------------
+
+
+def plane_ntt_kernel_specs(B: int, log_n: int, lde_factor: int | None = None,
+                           coset: int = int(gl.MULTIPLICATIVE_GENERATOR),
+                           mono: bool = True) -> list:
+    """(name, jitted_fn, args) triples for the plane transforms a resident
+    prove dispatches for a (B, 2^log_n) column stack — mirroring the
+    MXU-vs-XLA routing and the chunk walk of the u64 ntt_kernel_specs."""
+    from .ntt import chunk_shapes
+
+    n = 1 << log_n
+
+    def sdsp(*shape):
+        s = jax.ShapeDtypeStruct(shape, jnp.uint32)
+        return (s, s)
+
+    specs = []
+    if mono:
+        specs += [
+            (f"imono_limbres_b{b}_n{n}", _imono_p_jit, (sdsp(b, n),))
+            for b in chunk_shapes(B, n * 8)
+        ]
+    if lde_factor is None:
+        return specs
+    L = int(lde_factor)
+    coset = int(coset) % gl.P
+    mxu = _mxu_ntt_ready(n, None)
+    for b in chunk_shapes(B, n * 8 * L):
+        if not mxu:
+            specs.append((
+                f"lde_limbres_b{b}_n{n}_L{L}", _lde_p_jit,
+                (sdsp(b, n), L, coset),
+            ))
+            continue
+        from . import mxu_ntt
+
+        if log_n > mxu_ntt.MAX_LOG_N:
+            specs.append((
+                f"lde_hybrid_limbres_b{b}_n{n}_L{L}", _hybrid_p,
+                (sdsp(b, L, n), log_n, False),
+            ))
+            continue
+        ctx = mxu_ntt.get_mxu_ctx(log_n)
+        specs.append((
+            f"lde_mxu_limbres_b{b}_n{n}_L{L}", mxu_ntt._lde_planes,
+            (sdsp(b, ctx.R, ctx.C), sdsp(L, ctx.R, ctx.C), log_n, False),
+        ))
+    return specs

@@ -612,17 +612,419 @@ def fri_commit_sm(cur, k: int, cap_size: int, mesh: Mesh):
 
 
 def demesh(arr):
-    """Pull an array (or ext pair / MonomialSource) onto the default
-    single device — the correctness fallback where a mesh layout would
-    send a plain jit through the SPMD partitioner (legacy GSPMD round 5,
-    streamed DEEP sources, deep FRI fold tails)."""
-    from ..prover.streaming import MonomialSource
+    """Pull an array (or ext pair / MonomialSource / plane structures)
+    onto the default single device — the correctness fallback where a mesh
+    layout would send a plain jit through the SPMD partitioner (legacy
+    GSPMD round 5, streamed DEEP sources, deep FRI fold tails)."""
+    from ..prover.streaming import MonomialPlanesSource, MonomialSource
 
     dev = jax.devices()[0]
     if isinstance(arr, MonomialSource):
         return MonomialSource(jax.device_put(arr.mono, dev), arr.L)
+    if isinstance(arr, MonomialPlanesSource):
+        return MonomialPlanesSource(demesh(arr.mono), arr.L)
     if isinstance(arr, tuple):
         return tuple(demesh(a) for a in arr)
     if isinstance(arr, jax.Array):
         return jax.device_put(arr, dev)
     return arr
+
+
+# ---------------------------------------------------------------------------
+# Limb-resident twins (ISSUE 10): the same per-chip kernels + explicit
+# collectives over (lo, hi) u32 plane pairs. Each pivot/gather moves two
+# u32 planes instead of one u64 array — same total bytes, HALF the
+# per-element payload width — and every body computes in the limb domain
+# (ntt/limb_ntt.py, poseidon2 plane sponges), so values (digests, caps,
+# terms) are bit-identical to the u64 mesh path.
+# ---------------------------------------------------------------------------
+
+
+def pad_cols_sharded_p(p, mesh: Mesh):
+    """Plane twin of pad_cols_sharded."""
+    return pad_cols_sharded(p[0], mesh), pad_cols_sharded(p[1], mesh)
+
+
+def _ici_all_to_all_p(nbytes_global_pair: int, mesh: Mesh):
+    """Two u32-plane collectives = one logical pivot: bill each plane
+    (halved per-element width; the byte total equals the u64 pivot's)."""
+    _ici_all_to_all(nbytes_global_pair // 2, mesh)
+    _ici_all_to_all(nbytes_global_pair // 2, mesh)
+
+
+@lru_cache(maxsize=None)
+def _mono_fn_p(mesh: Mesh):
+    """Per-chip plane inverse NTT over the local column stripe."""
+    from ..ntt.limb_ntt import monomial_from_values_p
+
+    def body(vals_p):
+        with local_operands():
+            return monomial_from_values_p(vals_p)
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(_AXES, None),),
+            out_specs=P(_AXES, None), check_rep=False,
+        )
+    )
+
+
+def _pivot_planes(flat_p):
+    """The col->row layout pivot on a plane pair: one all_to_all per
+    plane (u32 payloads)."""
+    return (
+        jax.lax.all_to_all(
+            flat_p[0], _AXES, split_axis=1, concat_axis=0, tiled=True
+        ),
+        jax.lax.all_to_all(
+            flat_p[1], _AXES, split_axis=1, concat_axis=0, tiled=True
+        ),
+    )
+
+
+@lru_cache(maxsize=None)
+def _lde_pivot_leaf_fn_p(mesh: Mesh, L: int, B_real: int):
+    """Plane twin of _lde_pivot_leaf_fn: per-chip plane LDE, the plane
+    pivot, and the per-chip plane leaf sponge (fused kernel on TPU, XLA
+    limb rounds elsewhere — hashes/poseidon2.leaf_hash_planes)."""
+    from ..hashes.poseidon2 import leaf_hash_planes
+    from ..ntt.limb_ntt import lde_from_monomial_p
+
+    def body(mono_p):
+        b = mono_p[0].shape[0]
+        with local_operands():
+            lde = lde_from_monomial_p(mono_p, L)
+        flat = (lde[0].reshape(b, -1), lde[1].reshape(b, -1))
+        piv = _pivot_planes(flat)
+        leaves = (piv[0].T[:, :B_real], piv[1].T[:, :B_real])
+        with local_operands():
+            dig = leaf_hash_planes(leaves)
+        return lde, dig
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(_AXES, None),),
+            out_specs=(P(_AXES, None, None), P(_AXES, None)),
+            check_rep=False,
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _lde_pivot_cols_fn_p(mesh: Mesh, L: int, b_real: int):
+    """Plane twin of _lde_pivot_cols_fn (streamed block pivot)."""
+    from ..ntt.limb_ntt import lde_from_monomial_p
+
+    def body(mono_p):
+        b = mono_p[0].shape[0]
+        with local_operands():
+            lde = lde_from_monomial_p(mono_p, L)
+        piv = _pivot_planes((lde[0].reshape(b, -1), lde[1].reshape(b, -1)))
+        return piv[0].T[:, :b_real], piv[1].T[:, :b_real]
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(_AXES, None),),
+            out_specs=P(_AXES, None), check_rep=False,
+        )
+    )
+
+
+@lru_cache(maxsize=None)
+def _node_step_fn_p(mesh: Mesh):
+    """Plane twin of _node_step_fn."""
+    from ..hashes.poseidon2 import node_hash_planes
+
+    def body(d_p):
+        with local_operands():
+            return node_hash_planes(
+                (d_p[0][0::2], d_p[1][0::2]), (d_p[0][1::2], d_p[1][1::2])
+            )
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(_AXES, None),),
+            out_specs=P(_AXES, None), check_rep=False,
+        )
+    )
+
+
+def all_gather_replicated_p(p, mesh: Mesh):
+    """Plane twin of all_gather_replicated (two u32 gathers)."""
+    out = (
+        _all_gather_fn(mesh, p[0].ndim)(p[0]),
+        _all_gather_fn(mesh, p[1].ndim)(p[1]),
+    )
+    _ici_all_gather(int(p[0].size) * p[0].dtype.itemsize, mesh)
+    _ici_all_gather(int(p[1].size) * p[1].dtype.itemsize, mesh)
+    return out
+
+
+def node_layers_sm_p(digests_p, cap_size: int, mesh: Mesh):
+    """Plane twin of node_layers_sm."""
+    from ..merkle import _tree_tail_layers_planes
+
+    steps, gather = node_plan(
+        int(digests_p[0].shape[0]), cap_size, mesh_devices(mesh)
+    )
+    layers = [digests_p]
+    cur = digests_p
+    for _ in steps:
+        cur = _node_step_fn_p(mesh)(cur)
+        layers.append(cur)
+    if gather is not None:
+        rep = all_gather_replicated_p(cur, mesh)
+        layers.extend(_tree_tail_layers_planes(rep, cap_size))
+    return tuple(layers)
+
+
+def commit_from_mono_sm_p(mono_p, L: int, cap_size: int, mesh: Mesh):
+    """Plane twin of commit_from_mono_sm."""
+    B, n = int(mono_p[0].shape[0]), int(mono_p[0].shape[-1])
+    N = n * L
+    mono_pp = pad_cols_sharded_p(mono_p, mesh)
+    fn = _lde_pivot_leaf_fn_p(mesh, L, B)
+    with _pivot_timer():
+        lde_p, digests = fn(mono_pp)
+    _ici_all_to_all_p(int(mono_pp[0].shape[0]) * N * 8, mesh)
+    _metrics.count("merkle.sm_commits")
+    _metrics.count("merkle.resident_commits")
+    if lde_p[0].shape[0] != B:
+        lde_p = (lde_p[0][:B], lde_p[1][:B])
+    return lde_p, node_layers_sm_p(digests, cap_size, mesh)
+
+
+def streamed_leaf_digests_sm_p(mono_p, L: int, mesh: Mesh):
+    """Plane twin of streamed_leaf_digests_sm: per-chip plane absorb of
+    each pivoted block (streaming._absorb_cols_p)."""
+    from ..prover.streaming import (
+        COL_BLOCK,
+        _absorb_cols_p,
+        double_buffered_absorb,
+    )
+
+    B, n = int(mono_p[0].shape[0]), int(mono_p[0].shape[-1])
+    N = n * L
+    sh = NamedSharding(mesh, P(_AXES, None))
+    state = (
+        jax.device_put(jnp.zeros((N, 12), jnp.uint32), sh),
+        jax.device_put(jnp.zeros((N, 12), jnp.uint32), sh),
+    )
+
+    def _cols(i):
+        b = min(COL_BLOCK, B - i)
+        blk_p = pad_cols_sharded_p(
+            (mono_p[0][i : i + b], mono_p[1][i : i + b]), mesh
+        )
+        fn = _lde_pivot_cols_fn_p(mesh, L, b)
+        with _pivot_timer():
+            cols = fn(blk_p)
+        _ici_all_to_all_p(int(blk_p[0].shape[0]) * N * 8, mesh)
+        _metrics.count("stream.sm_blocks")
+        return cols
+
+    state = double_buffered_absorb(
+        state, range(0, B, COL_BLOCK), _cols, absorb=_absorb_cols_p
+    )
+    return state[0][:, :4], state[1][:, :4]
+
+
+def commit_pipeline_sm_p(values_p, L: int, cap_size: int, stream: bool,
+                         mesh: Mesh):
+    """Plane twin of commit_pipeline_sm."""
+    B = int(values_p[0].shape[0])
+    vp = pad_cols_sharded_p(values_p, mesh)
+    mono_pp = _mono_fn_p(mesh)(vp)
+    if mono_pp[0].shape[0] != B:
+        mono_pp = (mono_pp[0][:B], mono_pp[1][:B])
+    _metrics.count("ntt.monomial_from_values")
+    _metrics.count("ntt.resident_transforms")
+    if stream:
+        digests = streamed_leaf_digests_sm_p(mono_pp, L, mesh)
+        _metrics.count("merkle.streamed_commits")
+        _metrics.count("merkle.resident_commits")
+        return mono_pp, None, node_layers_sm_p(digests, cap_size, mesh)
+    lde, layers = commit_from_mono_sm_p(mono_pp, L, cap_size, mesh)
+    _metrics.count("ntt.lde_from_monomial")
+    _metrics.count("merkle.commits")
+    return mono_pp, lde, layers
+
+
+@lru_cache(maxsize=None)
+def _coset_eval_fn_p(mesh: Mesh, B_real: int):
+    """Plane twin of _coset_eval_fn: per-chip plane scale+NTT, plane
+    pivot to row sharding."""
+    from ..field import limbs
+    from ..ntt.limb_ntt import fft_natural_to_bitreversed_p
+
+    def body(mono_p, scale_row_p):
+        with local_operands():
+            v = fft_natural_to_bitreversed_p(
+                limbs.mul(
+                    mono_p, (scale_row_p[0][None, :], scale_row_p[1][None, :])
+                )
+            )
+        return (
+            jax.lax.all_to_all(
+                v[0], _AXES, split_axis=1, concat_axis=0, tiled=True
+            ),
+            jax.lax.all_to_all(
+                v[1], _AXES, split_axis=1, concat_axis=0, tiled=True
+            ),
+        )
+
+    smf = shard_map(
+        body, mesh=mesh, in_specs=(P(_AXES, None), P(None)),
+        out_specs=P(None, _AXES), check_rep=False,
+    )
+
+    @jax.jit
+    def fn(mono_p, scale_q_p, c_arr):
+        scale_row = (
+            jax.lax.dynamic_index_in_dim(
+                scale_q_p[0], c_arr, 0, keepdims=False
+            ),
+            jax.lax.dynamic_index_in_dim(
+                scale_q_p[1], c_arr, 0, keepdims=False
+            ),
+        )
+        out = smf(mono_p, scale_row)
+        return out[0][:B_real], out[1][:B_real]
+
+    return fn
+
+
+def coset_eval_q_sm_p(mono_p, scale_q_p, c_arr, B_real: int, mesh: Mesh):
+    """Plane twin of coset_eval_q_sm."""
+    fn = _coset_eval_fn_p(mesh, B_real)
+    with _pivot_timer():
+        out = fn(mono_p, scale_q_p, c_arr)
+    _ici_all_to_all_p(int(mono_p[0].shape[0] * mono_p[0].shape[-1]) * 8, mesh)
+    return out
+
+
+def sweep_shard_map_p(core_p, mesh: Mesh):
+    """Plane twin of sweep_shard_map: wraps the RESIDENT per-coset terms
+    core (plane stacks + host-built scalar table) in shard_map over
+    row-sharded plane evaluations."""
+    row = P(None, _AXES)
+    vec = P(_AXES)
+    rep = P(None)
+    smf = shard_map(
+        core_p, mesh=mesh,
+        in_specs=(
+            row, row, row, row, vec, vec, vec, rep,
+        ),
+        out_specs=(vec, vec), check_rep=False,
+    )
+
+    def body(
+        wit_p, setup_p, s2_p, zs_p, c_arr,
+        xs_q_p, l0_q_p, zhinv_q_p, table,
+    ):
+        n = wit_p[0].shape[-1]
+        start = c_arr * n
+
+        def _sl(p):
+            return (
+                jax.lax.dynamic_slice_in_dim(p[0], start, n),
+                jax.lax.dynamic_slice_in_dim(p[1], start, n),
+            )
+
+        return smf(
+            wit_p, setup_p, s2_p, zs_p,
+            _sl(xs_q_p), _sl(l0_q_p), _sl(zhinv_q_p), table,
+        )
+
+    return jax.jit(body)
+
+
+@lru_cache(maxsize=None)
+def _deep_fn_p(mesh: Mesh, nsrc: int, num_zw: int, num_lk: int, num_pi: int):
+    """Plane twin of _deep_fn: the whole resident DEEP accumulation as
+    ONE shard_map graph over domain shards."""
+    from ..prover.resident import _deep_extras_fn_p, _deep_main_sum_p
+
+    row = P(None, _AXES)
+    vec = P(_AXES)
+    rep = P(None)
+
+    def body(
+        srcs, y0s, y1s, c0s, c1s, inv_xz, inv_xzw,
+        cols_zw, cols_lk, inv_x, cols_pi, pi_denoms, pi_vals,
+        y_zw, y_lk0, ch0e, ch1e,
+    ):
+        h = _deep_main_sum_p(list(srcs), y0s, y1s, c0s, c1s, inv_xz)
+        return _deep_extras_fn_p(num_zw, num_lk, num_pi)(
+            h, cols_zw, cols_lk, cols_pi, inv_xzw, inv_x, pi_denoms,
+            y_zw, y_lk0, pi_vals, ch0e, ch1e,
+        )
+
+    in_specs = (
+        (row,) * nsrc, rep, rep, rep, rep, (vec, vec), (vec, vec),
+        row, row, vec if num_lk else rep, row, row, rep,
+        (rep, rep), (rep, rep), rep, rep,
+    )
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=in_specs,
+            out_specs=(vec, vec), check_rep=False,
+        )
+    )
+
+
+def deep_codeword_sm_p(
+    mesh: Mesh, deep_sources, y0s, y1s, c0s, c1s, inv_xz, prep,
+    y_zw, y_lk0, ch0e, ch1e, num_zw: int, num_lk: int, num_pi: int,
+):
+    """Plane twin of deep_codeword_sm; returns the ext codeword PLANE
+    pair row-sharded — the layout the resident per-chip FRI graphs
+    consume."""
+    fn = _deep_fn_p(mesh, len(deep_sources), num_zw, num_lk, num_pi)
+    _metrics.count("deep.sm_codewords")
+    _ici_all_to_all(
+        sum(
+            int(a.size) * a.dtype.itemsize
+            for pair in deep_sources
+            for a in pair
+        ),
+        mesh,
+    )
+    s2_cols = prep["s2_cols"]
+    cols_zw = (s2_cols[0][:num_zw], s2_cols[1][:num_zw])
+    cols_lk = (s2_cols[0][num_zw:], s2_cols[1][num_zw:])
+    with _pivot_timer():
+        return fn(
+            tuple(deep_sources), y0s, y1s, c0s, c1s,
+            inv_xz, prep["inv_xzw"],
+            cols_zw, cols_lk, prep["inv_x"],
+            prep["cols_pi"], prep["pi_denoms"], prep["pi_vals"],
+            y_zw, y_lk0, ch0e, ch1e,
+        )
+
+
+@lru_cache(maxsize=None)
+def _fri_leaf_fn_p(mesh: Mesh, k: int):
+    """Plane twin of _fri_leaf_fn."""
+    from ..hashes.poseidon2 import leaf_hash_planes
+
+    def body(c0, c1):
+        n_loc = c0[0].shape[0]
+        llo = jnp.stack([c0[0], c1[0]], axis=-1).reshape(n_loc >> k, -1)
+        lhi = jnp.stack([c0[1], c1[1]], axis=-1).reshape(n_loc >> k, -1)
+        with local_operands():
+            return leaf_hash_planes((llo, lhi))
+
+    return jax.jit(
+        shard_map(
+            body, mesh=mesh, in_specs=(P(_AXES), P(_AXES)),
+            out_specs=P(_AXES, None), check_rep=False,
+        )
+    )
+
+
+def fri_commit_sm_p(cur, k: int, cap_size: int, mesh: Mesh):
+    """Plane twin of fri_commit_sm."""
+    dig = _fri_leaf_fn_p(mesh, k)(cur[0], cur[1])
+    _metrics.count("fri.sm_commits")
+    return node_layers_sm_p(dig, cap_size, mesh)

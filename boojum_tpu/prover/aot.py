@@ -151,13 +151,17 @@ def variant_fingerprint(mesh_shape=None) -> dict:
     enumeration resolves it, so build and load can never disagree by
     parsing flags differently."""
     from ..utils import transfer as _transfer
-    from .pallas_sweep import limb_sweep_enabled
+    from .pallas_sweep import limb_resident_enabled, limb_sweep_enabled
     from .streaming import stream_threshold_bytes
 
     thresh = stream_threshold_bytes()
     return {
         "overlap": bool(_transfer.overlap_enabled()),
         "limb_sweep": bool(limb_sweep_enabled()),
+        # the resident variant is a DISJOINT kernel set (`*_limbres`
+        # ledger names); it must never share a bundle with the
+        # converting set
+        "limb_resident": bool(limb_resident_enabled()),
         "mesh_shape": _mesh_shape_list(mesh_shape),
         # inf is not JSON — the "streaming forced off" sentinel string is
         "stream_lde_bytes": (

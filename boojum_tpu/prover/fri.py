@@ -80,6 +80,44 @@ def _fold_once_limb_jit(values, ch, inv_x_pairs):
     return fri_fold(values, ch, inv_x_pairs)
 
 
+@lru_cache(maxsize=4)
+def fold_challenge_tables_p(log_full: int, num_rounds: int):
+    """Limb-resident twin of fold_challenge_tables: per-round 1/x PLANE
+    pairs. Domain points are host-built numpy (split on host), the shift
+    multiply and the Montgomery batch inversion run in the limb domain —
+    no device u64 exists anywhere (values are identical: inverses are
+    unique mod p and limb ops are exact)."""
+    from ..field import limb_ops as lop
+    from ..field import limbs
+    from ..ntt.ntt import _powers_np
+
+    tables = []
+    for r in range(num_rounds):
+        log_nr = log_full - r
+        shift = gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << r)
+        omega = gl.omega(log_nr)
+        lo, hi = limbs.split_np(_powers_np(omega, 1 << log_nr))
+        xs = (jnp.asarray(lo), jnp.asarray(hi))
+        xs = limbs.mul_const(xs, limbs.const_pair(shift))
+        brev = jnp.asarray(bitreverse_indices(log_nr))
+        xs_pairs = (xs[0][brev][0::2], xs[1][brev][0::2])
+        tables.append(lop.batch_inverse_jit(xs_pairs))
+    return tables
+
+
+def _ch_table_np(ch):
+    """Host (c0, c1) ext challenge -> (4, 1) u32 scalar table (built on
+    host: the resident fold's challenges never touch device u64)."""
+    c0, c1 = int(ch[0]), int(ch[1])
+    return np.array(
+        [
+            [c0 & 0xFFFFFFFF], [c0 >> 32],
+            [c1 & 0xFFFFFFFF], [c1 >> 32],
+        ],
+        dtype=np.uint32,
+    )
+
+
 def fold_once(values, challenge, inv_x_pairs):
     """values: ext pair over round-r domain (brev layout); returns N/2 ext.
 
@@ -222,6 +260,86 @@ def _fri_final_fused(c0, c1, shift_inv: int):
     return m0, m1
 
 
+# ---------------------------------------------------------------------------
+# Limb-resident FRI (ISSUE 10): commit, fold chain and final interpolation
+# on (lo, hi) u32 plane pairs — the codeword arrives resident from DEEP and
+# never converts; caps and final monomials join on HOST at the API edge.
+# ---------------------------------------------------------------------------
+
+
+@lru_cache(maxsize=None)
+def _fri_commit_fn_p(k: int, cap: int):
+    """Resident oracle commit: leaf regrouping + plane leaf sponge + plane
+    node layers in ONE dispatch (the _fri_commit_fn twin)."""
+    from ..hashes.poseidon2 import leaf_hash_planes
+    from ..merkle import _node_layers_planes_body
+
+    @jax.jit
+    def fn(c0, c1):
+        N = c0[0].shape[0]
+        llo = jnp.stack([c0[0], c1[0]], axis=-1).reshape(N >> k, -1)
+        lhi = jnp.stack([c0[1], c1[1]], axis=-1).reshape(N >> k, -1)
+        dig = leaf_hash_planes((llo, lhi))
+        return _node_layers_planes_body(dig, cap)
+
+    return fn
+
+
+@lru_cache(maxsize=None)
+def _fri_fold_fn_p(k: int, mesh=None):
+    """Resident k-fold for one schedule entry: the whole chain — including
+    the squared sub-challenges — runs on planes (pallas_sweep.
+    fri_fold_planes), so nothing converts between folds. `tb` is the
+    (4, 1) u32 challenge table (host-built)."""
+    from ..field import limb_ops as lop
+    from .pallas_sweep import fri_fold_planes
+
+    def body(c0, c1, tb, *tabs):
+        cur = (c0, c1)
+        sub = ((tb[0], tb[1]), (tb[2], tb[3]))
+        for j in range(k):
+            tbj = jnp.stack([sub[0][0], sub[0][1], sub[1][0], sub[1][1]])
+            cur = fri_fold_planes(cur, tbj, tabs[j])
+            sub = lop.ext_mul(sub, sub)
+        return cur
+
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        spec = P(("col", "row"))
+        smf = shard_map(
+            body, mesh=mesh,
+            in_specs=(spec, spec, P(None)) + (spec,) * k,
+            out_specs=(spec, spec), check_rep=False,
+        )
+
+        @jax.jit
+        def fn(c0, c1, tb, tables):
+            return smf(c0, c1, tb, *tables)
+
+        return fn
+
+    @jax.jit
+    def fn(c0, c1, tb, tables):
+        return body(c0, c1, tb, *tables)
+
+    return fn
+
+
+@_partial(jax.jit, static_argnums=(2,))
+def _fri_final_p(c0, c1, shift_inv: int):
+    """Resident final interpolation: plane iNTTs + host-built unshift."""
+    from ..ntt.limb_ntt import (
+        distribute_powers_p,
+        ifft_bitreversed_to_natural_p,
+    )
+
+    m0 = distribute_powers_p(ifft_bitreversed_to_natural_p(c0), shift_inv)
+    m1 = distribute_powers_p(ifft_bitreversed_to_natural_p(c1), shift_inv)
+    return m0, m1
+
+
 def fri_kernel_specs(base_degree: int, config, mesh=None) -> list:
     """(name, jitted_fn, args) triples for every top-level executable a
     fused `fri_prove` dispatches for this (base_degree, config) — the
@@ -230,10 +348,14 @@ def fri_kernel_specs(base_degree: int, config, mesh=None) -> list:
     before the first prove. Mirrors the schedule/shape walk of fri_prove;
     args are ShapeDtypeStructs (no device memory)."""
 
-    from .pallas_sweep import limb_sweep_enabled
+    from .pallas_sweep import limb_resident_enabled, limb_sweep_enabled
 
     def sds(*shape):
         return jax.ShapeDtypeStruct(shape, jnp.uint64)
+
+    def sdsp(*shape):
+        s = jax.ShapeDtypeStruct(shape, jnp.uint32)
+        return (s, s)
 
     N = base_degree * config.fri_lde_factor
     log_full = N.bit_length() - 1
@@ -249,16 +371,46 @@ def fri_kernel_specs(base_degree: int, config, mesh=None) -> list:
     # enumerate the fold variant this process will actually dispatch (the
     # overlap-mode idiom in prover/precompile.py) — compiling the other
     # would be pure waste on the tunnel compiler. Under a shard_map mesh
-    # that is the per-chip fold chain, ledger-tagged `_sm`.
+    # that is the per-chip fold chain, ledger-tagged `_sm`; under limb
+    # residency the PLANE chain, ledger-tagged `_limbres`.
     from ..parallel.sharding import shard_map_mesh
     from ..parallel.shard_sweep import fold_shards_ok
 
     limb = limb_sweep_enabled()
+    resident = limb_resident_enabled()
     smm = mesh if mesh is not None else shard_map_mesh()
-    fold_tag = "_limb" if limb else ""
+    fold_tag = "_limbres" if resident else ("_limb" if limb else "")
     for k in schedule:
         mesh_k = smm if smm is not None and fold_shards_ok(cur, k, smm) \
             else None
+        if resident:
+            ext_p = (sdsp(cur), sdsp(cur))
+            if mesh_k is not None:
+                from ..parallel.shard_sweep import _fri_leaf_fn_p
+
+                specs.append((
+                    f"fri_leaf_limbres_k{k}_n{cur}_sm",
+                    _fri_leaf_fn_p(mesh_k, k),
+                    ext_p,
+                ))
+            else:
+                specs.append((
+                    f"fri_commit_limbres_k{k}_n{cur}",
+                    _fri_commit_fn_p(k, cap),
+                    ext_p,
+                ))
+            tables = tuple(
+                sdsp(1 << (log_full - fold_round - j - 1)) for j in range(k)
+            )
+            specs.append((
+                f"fri_fold{fold_tag}_k{k}_n{cur}"
+                + ("_sm" if mesh_k is not None else ""),
+                _fri_fold_fn_p(k, mesh_k),
+                ext_p + (jax.ShapeDtypeStruct((4, 1), jnp.uint32), tables),
+            ))
+            fold_round += k
+            cur >>= k
+            continue
         if mesh_k is not None:
             from ..parallel.shard_sweep import _fri_leaf_fn
 
@@ -285,9 +437,16 @@ def fri_kernel_specs(base_degree: int, config, mesh=None) -> list:
         fold_round += k
         cur >>= k
     shift_inv = gl.inv(gl.pow_(gl.MULTIPLICATIVE_GENERATOR, 1 << num_folds))
-    specs.append((
-        f"fri_final_n{cur}", _fri_final_fused, (sds(cur), sds(cur), shift_inv)
-    ))
+    if resident:
+        specs.append((
+            f"fri_final_limbres_n{cur}", _fri_final_p,
+            (sdsp(cur), sdsp(cur), shift_inv),
+        ))
+    else:
+        specs.append((
+            f"fri_final_n{cur}", _fri_final_fused,
+            (sds(cur), sds(cur), shift_inv),
+        ))
     return specs
 
 
@@ -306,7 +465,13 @@ def fri_prove(
     from .pallas_sweep import limb_sweep_enabled
 
     out = FriOracles()
-    N = int(codeword[0].shape[0])
+    # a resident codeword arrives as an ext PLANE pair ((lo,hi),(lo,hi))
+    # straight from the DEEP accumulation (ISSUE 10) and stays planes
+    # through every commit and fold; only the final monomials (and caps,
+    # via the plane trees) join — on host, at the transcript edge
+    resident = isinstance(codeword[0], tuple)
+    _arr0 = codeword[0][0] if resident else codeword[0]
+    N = int(_arr0.shape[0])
     log_full = N.bit_length() - 1
     schedule = fold_schedule(
         base_degree, config.fri_final_degree,
@@ -314,13 +479,17 @@ def fri_prove(
     )
     out.schedule = schedule
     num_folds = sum(schedule)
-    tables = fold_challenge_tables(log_full, num_folds)
+    if resident:
+        assert fused, "the resident codeword runs the fused FRI path"
+        tables = fold_challenge_tables_p(log_full, num_folds)
+    else:
+        tables = fold_challenge_tables(log_full, num_folds)
     limb = limb_sweep_enabled()
     from ..parallel.sharding import shard_map_mesh
     from ..parallel.shard_sweep import fold_shards_ok
 
     smm = shard_map_mesh()
-    if smm is not None and len(codeword[0].devices()) <= 1:
+    if smm is not None and len(_arr0.devices()) <= 1:
         # streamed proves de-mesh their round-5 inputs (the DEEP sources
         # regenerate blocks inside plain jits), so the codeword arrives
         # on ONE device — the per-chip commit/fold graphs would reject
@@ -330,23 +499,39 @@ def fri_prove(
     cur = codeword
     fold_round = 0
     for r, k in enumerate(schedule):
-        with _span(f"fri_oracle_{r}", k=k, limb=limb):
+        with _span(f"fri_oracle_{r}", k=k, limb=limb, resident=resident):
             # per-chip commit + fold chain while every intermediate local
             # size stays even; deep tails are pulled onto one device and
             # take the meshless graphs (the arrays are small there, and a
             # plain jit over a still-sharded operand would go through the
             # SPMD partitioner)
+            cur_n = int((cur[0][0] if resident else cur[0]).shape[0])
             mesh_k = (
                 smm
-                if smm is not None
-                and fold_shards_ok(int(cur[0].shape[0]), k, smm)
+                if smm is not None and fold_shards_ok(cur_n, k, smm)
                 else None
             )
             if smm is not None and mesh_k is None:
                 from ..parallel.shard_sweep import demesh
 
                 cur = demesh(cur)
-            if fused:
+            if resident:
+                from ..merkle import PlaneMerkleTree
+
+                if mesh_k is not None:
+                    from ..parallel.shard_sweep import fri_commit_sm_p
+
+                    layers = fri_commit_sm_p(
+                        cur, k, config.merkle_tree_cap_size, mesh_k
+                    )
+                else:
+                    layers = _fri_commit_fn_p(
+                        k, config.merkle_tree_cap_size
+                    )(cur[0], cur[1])
+                tree = PlaneMerkleTree.from_layers(
+                    list(layers), config.merkle_tree_cap_size
+                )
+            elif fused:
                 if mesh_k is not None:
                     from ..parallel.shard_sweep import fri_commit_sm
 
@@ -375,7 +560,17 @@ def fri_prove(
             _metrics.count("fri.folds", k)
             if limb:
                 _metrics.count("fri.limb_folds", k)
-            if fused:
+            if resident:
+                _metrics.count("fri.resident_folds", k)
+                if mesh_k is not None:
+                    _metrics.count("fri.sm_folds", k)
+                tb = jnp.asarray(_ch_table_np(ch))
+                cur = _fri_fold_fn_p(k, mesh_k)(
+                    cur[0], cur[1], tb,
+                    tuple(tables[fold_round : fold_round + k]),
+                )
+                fold_round += k
+            elif fused:
                 ch01 = jnp.asarray(np.array([ch[0], ch[1]], dtype=np.uint64))
                 if mesh_k is not None:
                     _metrics.count("fri.sm_folds", k)
@@ -398,7 +593,9 @@ def fri_prove(
             from ..parallel.shard_sweep import demesh
 
             cur = demesh(cur)
-        if fused:
+        if resident:
+            mono0, mono1 = _fri_final_p(cur[0], cur[1], shift_inv)
+        elif fused:
             mono0, mono1 = _fri_final_fused(cur[0], cur[1], shift_inv)
         else:
             mono0 = distribute_powers(
@@ -411,7 +608,18 @@ def fri_prove(
     # blocking host_np syncs; overlapped: one, started async)
     from ..utils.transfer import fetch_np
 
-    m0, m1 = fetch_np(mono0, mono1, label="fri_final_monomials")
+    if resident:
+        # planes leave the device; u64 reassembles on HOST (the API edge)
+        from ..field.limbs import join_np
+
+        got = fetch_np(
+            mono0[0], mono0[1], mono1[0], mono1[1],
+            label="fri_final_monomials",
+        )
+        m0 = join_np(got[0], got[1])
+        m1 = join_np(got[2], got[3])
+    else:
+        m0, m1 = fetch_np(mono0, mono1, label="fri_final_monomials")
     deg_bound = base_degree >> num_folds
     assert (m0[deg_bound:] == 0).all() and (m1[deg_bound:] == 0).all(), (
         "final FRI polynomial exceeds degree bound"

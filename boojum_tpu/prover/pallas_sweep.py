@@ -102,7 +102,38 @@ def limb_sweep_enabled() -> bool:
         return False
     if backend == "tpu":
         return True
-    return explicit is True
+    # an explicit limb-RESIDENT opt-in implies the limb kernels: the
+    # resident pipeline has no u64 kernel set to fall back to
+    return explicit is True or env_flag_opt("BOOJUM_TPU_LIMB_RESIDENT") is True
+
+
+def limb_resident_enabled() -> bool:
+    """True when (lo, hi) u32 limb planes are the CANONICAL on-device
+    representation for the whole prove (ISSUE 10): witness columns enter
+    as planes at H2D, stay planes through iNTT/LDE, sponges, the quotient
+    sweep, DEEP and FRI, and `limbs.join` survives only at the API edge
+    (transcript absorbs, query openings, proof serialization).
+
+    BOOJUM_TPU_LIMB_RESIDENT: default ON where the limb sweep is native
+    (TPU backend — meshless or shard_map); `=0` restores the u64-resident
+    path bit-for-bit; `=1` opts in elsewhere (CPU runs the same plane
+    pipeline with interpret-mode/XLA limb kernels — how the tier-1 parity
+    tests run). Residency requires the limb kernel family, so every
+    limb_sweep_enabled() veto (GSPMD mesh, force_xla, LIMB_SWEEP=0)
+    also disables it."""
+    from ..utils.transfer import env_flag_opt
+
+    explicit = env_flag_opt("BOOJUM_TPU_LIMB_RESIDENT")
+    if explicit is False:
+        return False
+    if not limb_sweep_enabled():
+        return False
+    if explicit is True:
+        return True
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
 
 
 def _interpret() -> bool:
@@ -141,33 +172,62 @@ def _sc_ext(tb, j, like):
     )
 
 
-def _tiled_ext_call(
-    body, ins, table, extra_tables=(), num_ext_out=1, interpret=None
-):
-    """Run `body` over limb planes of the u64 column stacks `ins`.
+def _in_planes(x, shape):
+    """An input stack as reshaped planes: a (lo, hi) plane pair passes
+    through (the resident path — NO conversion), a u64 array splits at
+    this call boundary (the converting path)."""
+    if isinstance(x, tuple):
+        return x[0].reshape(shape), x[1].reshape(shape)
+    return limbs.split(x.reshape(shape))
 
-    ins: list of (B_i, n) uint64 arrays (same n). table: (4, S) uint32
-    scalar table (SMEM). extra_tables: int32 2-D tables (SMEM; packed gate
-    programs). body(table, tables, pairs) receives pairs[i] = (lo, hi)
-    uint32 arrays of block shape (B_i, T, 128) and returns `num_ext_out`
-    ext limb elements of shape (T, 128). Returns that many (c0, c1) uint64
-    (n,) pairs.
+
+def _in_rows(x) -> int:
+    return int((x[0] if isinstance(x, tuple) else x).shape[0])
+
+
+def _in_n(x) -> int:
+    return int((x[0] if isinstance(x, tuple) else x).shape[-1])
+
+
+def _tiled_ext_call(
+    body, ins, table, extra_tables=(), num_ext_out=1, interpret=None,
+    planes_out=False,
+):
+    """Run `body` over limb planes of the column stacks `ins`.
+
+    ins: list of (B_i, n) uint64 arrays OR (lo, hi) u32 plane pairs (the
+    limb-resident path — plane inputs enter the kernel with no conversion
+    at all). table: (4, S) uint32 scalar table (SMEM). extra_tables: int32
+    2-D tables (SMEM; packed gate programs). body(table, tables, pairs)
+    receives pairs[i] = (lo, hi) uint32 arrays of block shape (B_i, T, 128)
+    and returns `num_ext_out` ext limb elements of shape (T, 128). Returns
+    that many (c0, c1) uint64 (n,) pairs — or, with `planes_out`, ext limb
+    pairs ((lo, hi), (lo, hi)) of (n,) planes (resident callers keep the
+    output resident; `limbs.join` never runs).
 
     Domains that don't tile (n % 128 != 0) run `body` directly on
     (B_i, 1, n) planes — same code, plain XLA."""
-    n = int(ins[0].shape[-1])
+    n = _in_n(ins[0])
     if interpret is None:
         interpret = _interpret()
     extra_tables = tuple(jnp.asarray(t) for t in extra_tables)
     if n % _LANE != 0:
-        pairs = [limbs.split(x.reshape(x.shape[0], 1, n)) for x in ins]
+        pairs = [_in_planes(x, (_in_rows(x), 1, n)) for x in ins]
         outs = body(table, extra_tables, pairs)
+        if planes_out:
+            return tuple(
+                (
+                    (c0[0].reshape(n), c0[1].reshape(n)),
+                    (c1[0].reshape(n), c1[1].reshape(n)),
+                )
+                for (c0, c1) in outs
+            )
         return tuple(
             (limbs.join(c0).reshape(n), limbs.join(c1).reshape(n))
             for (c0, c1) in outs
         )
     R = n // _LANE
-    total_rows = sum(int(x.shape[0]) for x in ins) + 2 * num_ext_out
+    total_rows = sum(_in_rows(x) for x in ins) + 2 * num_ext_out
     budget_rows = max(8, (4 << 20) // max(total_rows * _LANE * 8, 1))
     tile = pick_tile(R, budget_rows)
     grid = (R // tile,)
@@ -183,8 +243,8 @@ def _tiled_ext_call(
         in_specs.append(_smem_spec(t))
         args.append(t)
     for x in ins:
-        B = int(x.shape[0])
-        lo, hi = limbs.split(x.reshape(B, R, _LANE))
+        B = _in_rows(x)
+        lo, hi = _in_planes(x, (B, R, _LANE))
         spec = pl.BlockSpec(
             (B, tile, _LANE),
             imap32(lambda r: (0, r, 0)),
@@ -227,6 +287,17 @@ def _tiled_ext_call(
     )(*args)
     outs = []
     for k in range(num_ext_out):
+        if planes_out:
+            outs.append(
+                (
+                    (planes[4 * k].reshape(n), planes[4 * k + 1].reshape(n)),
+                    (
+                        planes[4 * k + 2].reshape(n),
+                        planes[4 * k + 3].reshape(n),
+                    ),
+                )
+            )
+            continue
         c0 = limbs.join((planes[4 * k], planes[4 * k + 1])).reshape(n)
         c1 = limbs.join((planes[4 * k + 2], planes[4 * k + 3])).reshape(n)
         outs.append((c0, c1))
@@ -561,6 +632,34 @@ def build_coset_terms(gates, selector_paths, geometry, lk_ctx, non_residues):
         )
         return out
 
+    # scalar-table column count past the alpha block (call's layout):
+    # [beta, gamma, lkb, lkg] + with lookups [gpow(width+1), beta']
+    _extra_cols = 4 + ((width + 2) if lookups else 0)
+
+    def call_planes(
+        wit_p, setup_p, s2_p, zs_p, xs_p, l0_p, zh_p, table
+    ):
+        """The RESIDENT entry (ISSUE 10): every oracle stack arrives as a
+        (lo, hi) u32 plane pair and the terms come back as an ext plane
+        pair — no u64 exists anywhere in the round. `table` is the (4, S)
+        u32 scalar table prebuilt on HOST from the transcript challenges
+        (prover/resident.py builds it in `call`'s exact column layout)."""
+        A = int(table.shape[1]) - _extra_cols
+        (out,) = _tiled_ext_call(
+            partial(body, A=A),
+            [
+                wit_p, setup_p, s2_p, zs_p,
+                (xs_p[0][None], xs_p[1][None]),
+                (l0_p[0][None], l0_p[1][None]),
+                (zh_p[0][None], zh_p[1][None]),
+            ],
+            table,
+            extra_tables=tabs_static,
+            planes_out=True,
+        )
+        return out
+
+    call.planes = call_planes
     return call
 
 
@@ -712,6 +811,33 @@ def gate_terms_fn(gates, selector_paths, geometry, interpret=None):
         )
         return out
 
+    def fn_planes(copy_p, wit_p, const_p, table):
+        """Resident entry: plane stacks + a prebuilt (4, S) u32 table."""
+        ins = [copy_p]
+        has_wit = wit_p is not None
+        if has_wit:
+            ins.append(wit_p)
+        ins.append(const_p)
+
+        def body(tb, tabs, pairs):
+            if has_wit:
+                copy_pp, wit_pp, const_pp = pairs
+            else:
+                copy_pp, const_pp = pairs
+                wit_pp = None
+            like = copy_pp[0][0]
+            acc, _t = _gate_terms(
+                tb, tabs, like, copy_pp, wit_pp, const_pp, plan, a_col=0
+            )
+            return (acc,)
+
+        (out,) = _tiled_ext_call(
+            body, ins, table, extra_tables=tabs_static,
+            interpret=interpret, planes_out=True,
+        )
+        return out
+
+    fn.planes = fn_planes
     return fn
 
 
@@ -756,5 +882,26 @@ def fri_fold(values, ch, inv_x_pairs, interpret=None):
     table = _pack_table(c0, c1)
     (out,) = _tiled_ext_call(
         _fold_body, [quad, inv_x_pairs[None]], table, interpret=interpret
+    )
+    return out
+
+
+def fri_fold_planes(values_p, table, inv_x_p, interpret=None):
+    """Resident FRI fold (ISSUE 10): `values_p` is an ext plane pair over
+    the round domain, `table` the (4, 1) u32 challenge table, `inv_x_p` the
+    1/x plane pair at pair positions. Returns the half-size ext plane pair
+    — the fold CHAIN stays resident across rounds, where the converting
+    `fri_fold` paid a split+join per fold."""
+    c0p, c1p = values_p
+    quad = (
+        jnp.stack([c0p[0][0::2], c1p[0][0::2], c0p[0][1::2], c1p[0][1::2]]),
+        jnp.stack([c0p[1][0::2], c1p[1][0::2], c0p[1][1::2], c1p[1][1::2]]),
+    )
+    (out,) = _tiled_ext_call(
+        _fold_body,
+        [quad, (inv_x_p[0][None], inv_x_p[1][None])],
+        table,
+        interpret=interpret,
+        planes_out=True,
     )
     return out

@@ -47,6 +47,17 @@ def _sds(*shape):
     return jax.ShapeDtypeStruct(shape, jnp.uint64)
 
 
+def _sdsp(*shape):
+    """A (lo, hi) u32 plane-pair ShapeDtypeStruct (the limb-resident
+    kernel set's argument unit, ISSUE 10)."""
+    s = jax.ShapeDtypeStruct(shape, jnp.uint32)
+    return (s, s)
+
+
+def _u32(*shape):
+    return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+
 def _i32():
     return jax.ShapeDtypeStruct((), jnp.int32)
 
@@ -115,6 +126,14 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
     else:
         smm = mesh_shape  # an already-built Mesh
     D = SS.mesh_devices(smm) if smm is not None else 1
+
+    # limb residency (ISSUE 10): the resident prove dispatches a DISJOINT
+    # plane-kernel set (`*_limbres` ledger names) — enumerate exactly that
+    # set, never both (the variant also rides prover/aot.py's bundle key)
+    from .pallas_sweep import limb_resident_enabled
+
+    if limb_resident_enabled():
+        return _enumerate_resident(assembly, config, smm, D)
 
     # ONE derivation of every shape-keyed quantity, shared with the
     # service admission queue and the compile-ledger tags (shape_key.py)
@@ -410,6 +429,306 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
 
     # dedupe identical (fn, args) pairs surfaced under several tags — one
     # executable serves them all, compiling it twice is pure waste
+    seen = set()
+    out = []
+    for s in specs:
+        key = (id(s.fn), repr(s.args))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(s)
+    return out
+
+
+def _enumerate_resident(assembly, config, smm, D) -> list[KernelSpec]:
+    """The limb-RESIDENT kernel library (enumerate_kernels' plane twin):
+    every executable a resident prove dispatches, with `_limbres`-tagged
+    ledger names and (lo, hi) u32 plane-pair argument specs. Mirrors the
+    derivations of prover._prove_impl's resident branches exactly."""
+    from ..field import limb_ops as lop
+    from ..merkle import leaf_digests_planes, node_layers_planes
+    from ..ntt.limb_ntt import plane_ntt_kernel_specs
+    from .fri import fri_kernel_specs
+    from .setup import build_selector_tree, non_residues_for_copy_permutation
+    from .shape_key import shape_bucket
+    from .streaming import (
+        COL_BLOCK,
+        _absorb_cols_p,
+        _lde_block_cols_p,
+        use_streamed_lde,
+    )
+    from . import prover as P
+    from . import resident as RES
+    from ..parallel import shard_sweep as SS
+    from ..utils import transfer as _transfer
+
+    sb = shape_bucket(assembly, config)
+    n, log_n, L, N, cap = (
+        sb.trace_len, sb.log_n, sb.lde_factor, sb.domain_len, sb.cap_size
+    )
+    Cg, LC, Ct, W = sb.num_copy_cols, sb.num_lookup_cols, sb.Ct, sb.num_wit_cols
+    lookups = sb.lookups
+    lk_mode = assembly.lookup_mode
+    R_args = sb.lookup_subargs
+    M, K, TW, width = sb.M, sb.num_constant_cols, sb.TW, sb.lookup_width
+    chunks = list(sb.chunks)
+    num_chunks = sb.num_chunks
+    num_partials = num_chunks - 1
+    S, B_wit, B_setup = sb.S, sb.B_wit, sb.B_setup
+    _tree, selector_paths = build_selector_tree(assembly.gates)
+    Q = sb.quotient_degree
+    B_q = sb.B_q
+    B_all = sb.B_all
+    non_residues = non_residues_for_copy_permutation(Ct)
+    stream = use_streamed_lde(B_all, N)
+    stream_setup = use_streamed_lde(B_setup, N)
+
+    specs: list[KernelSpec] = []
+
+    def add(name, fn, *args):
+        specs.append(KernelSpec(name, fn, args))
+
+    # ---- commit pipelines (plane NTT + plane sponges) --------------------
+    absorb_blocks: set[int] = set()
+
+    def commit_specs(tag, B, streamed, mono=True):
+        if smm is not None:
+            Bp = SS.padded_cols(B, D)
+            if mono:
+                add(
+                    f"{tag}:mono_limbres_sm", SS._mono_fn_p(smm),
+                    _sdsp(Bp, n),
+                )
+            if streamed:
+                for i in range(0, B, COL_BLOCK):
+                    absorb_blocks.add(min(COL_BLOCK, B - i))
+            else:
+                add(
+                    f"{tag}:lde_pivot_leaf_limbres_sm",
+                    SS._lde_pivot_leaf_fn_p(smm, L, B), _sdsp(Bp, n),
+                )
+            return
+        for nm, fn, args in plane_ntt_kernel_specs(
+            B, log_n, None if streamed else L, mono=mono
+        ):
+            add(f"{tag}:{nm}", fn, *args)
+        if streamed:
+            for i in range(0, B, COL_BLOCK):
+                absorb_blocks.add(min(COL_BLOCK, B - i))
+        else:
+            add(
+                f"{tag}:leaf_digests_limbres", leaf_digests_planes,
+                _sdsp(B, L, n),
+            )
+
+    commit_specs("wit", B_wit, stream)
+    commit_specs("s2", S, stream)
+    commit_specs("q", B_q, False, mono=False)
+    commit_specs("setup", B_setup, stream_setup)
+    for b in sorted(absorb_blocks):
+        if smm is not None:
+            add(
+                f"lde_pivot_cols_limbres_b{b}_sm",
+                SS._lde_pivot_cols_fn_p(smm, L, b),
+                _sdsp(SS.padded_cols(b, D), n),
+            )
+        else:
+            # the resident streamed commit dispatches the split pair in
+            # BOTH overlap modes (streaming.streamed_leaf_digests_blocks_p)
+            add(
+                f"lde_block_cols_limbres_b{b}", _lde_block_cols_p,
+                _sdsp(b, n), L,
+            )
+        add(
+            f"absorb_cols_limbres_b{b}", _absorb_cols_p,
+            _sdsp(N, 12), _sdsp(N, b),
+        )
+    if smm is None:
+        add("node_layers_limbres", node_layers_planes, _sdsp(N, 4), cap)
+    else:
+        steps, gather = SS.node_plan(N, cap, D)
+        for cur in steps:
+            add("node_step_limbres_sm", SS._node_step_fn_p(smm), _sdsp(cur, 4))
+        if gather is not None:
+            add(
+                "node_gather_limbres_sm", SS._all_gather_fn(smm, 2),
+                _u32(gather, 4),
+            )
+    if _transfer.overlap_enabled():
+        wit_groups = [Cg] + ([LC] if LC else []) + ([W] if W else []) \
+            + ([1] if M else [])
+        upload_parts = _transfer.upload_chunk_shapes(wit_groups, n)
+        if len(upload_parts) > 1:
+            add(
+                "witness_upload_concat_limbres", _transfer._concat_jit(),
+                *[_u32(b, n) for b in upload_parts],
+            )
+
+    # ---- round 2 plane twins ---------------------------------------------
+    chunks_t = tuple(tuple(c) for c in chunks)
+    bg8 = _u32(8)
+    pairp = lambda *shape: (_sdsp(*shape), _sdsp(*shape))  # noqa: E731
+    add(
+        "chunk_num_den_limbres", RES._all_chunk_num_den_p,
+        _sdsp(Ct, n), _sdsp(Ct, n), _sdsp(Ct), (_sdsp(n), bg8), chunks_t,
+    )
+    add(
+        "ext_binv_chunks_limbres", lop.ext_batch_inverse_jit,
+        pairp(num_chunks, n),
+    )
+    if lookups:
+        lk_cols = _sdsp(LC, n) if lk_mode == "specialized" else _sdsp(Cg, n)
+        add(
+            "lookup_denominators_limbres", RES._lookup_denominators_p,
+            lk_cols, (_sdsp(n), _sdsp(width + 1, n)), bg8, R_args, width,
+        )
+        add(
+            "ext_binv_lookup_limbres", lop.ext_batch_inverse_jit,
+            pairp(R_args + 1, n),
+        )
+    add(
+        "z_and_partials_limbres", RES._z_and_partials_p,
+        pairp(num_chunks, n), pairp(num_chunks, n),
+    )
+    stack_fn = RES.stage2_stack_fn_p(assembly, selector_paths)
+    lk_inv = pairp(R_args + 1, n) if lookups else None
+    mult = _sdsp(n) if lookups else None
+    consts = _sdsp(K, n) if (lookups and lk_mode == "general") else None
+    add(
+        "stage2_stack_limbres", stack_fn, pairp(n), pairp(num_partials, n),
+        lk_inv, mult, consts,
+    )
+
+    # ---- round 3: plane evals + resident sweep + interp ------------------
+    from .stages import num_gate_sweep_terms
+
+    total_alpha_terms = (
+        num_gate_sweep_terms(assembly)
+        + 1 + num_chunks
+        + ((R_args + 1) if lookups else 0)
+    )
+    capA = _next_pow2(total_alpha_terms)
+    add("zshift_limbres", RES._zshift_p, _sdsp(2, n), _sdsp(n))
+    for tag, B in (
+        ("wit", B_wit), ("setup", B_setup), ("s2", S), ("zs", 2)
+    ):
+        if smm is None:
+            add(
+                f"coset_eval_{tag}_limbres", RES._coset_eval_q_p,
+                _sdsp(B, n), _sdsp(Q, n), _i32(),
+            )
+        else:
+            add(
+                f"coset_eval_{tag}_limbres_sm", SS._coset_eval_fn_p(smm, B),
+                _sdsp(SS.padded_cols(B, D), n), _sdsp(Q, n), _i32(),
+            )
+    mk_path = None
+    if lookups and lk_mode == "general":
+        mk_path = selector_paths[assembly.lookup_marker_gid()]
+    lk_ctx = (
+        lookups, lk_mode, R_args, width, num_partials, chunks_t,
+        total_alpha_terms, Cg, Ct, W, K, M,
+        tuple(mk_path) if mk_path is not None else None,
+    )
+    sweep = P._coset_sweep_fn(
+        assembly, selector_paths, non_residues, lk_ctx, sm_mesh=smm
+    )
+    S_cols = capA + 4 + ((width + 2) if lookups else 0)
+    add(
+        "coset_sweep_terms_limbres" + ("_sm" if smm is not None else ""),
+        sweep,
+        _sdsp(B_wit, n), _sdsp(B_setup, n), _sdsp(S, n), _sdsp(2, n),
+        _i32(), _sdsp(Q * n), _sdsp(Q * n), _sdsp(Q * n), _u32(4, S_cols),
+    )
+    add(
+        "quotient_interp_limbres", RES._quotient_interp_p,
+        tuple(_sdsp(n) for _ in range(Q)),
+        tuple(_sdsp(n) for _ in range(Q)),
+        Q, n,
+    )
+
+    # ---- rounds 4-5 plane twins ------------------------------------------
+    num_lk = (R_args + 1) if lookups else 0
+    num_pi = len(assembly.public_inputs)
+    sc4 = _u32(4)
+    add(
+        "evals_limbres", RES._evals_p, _sdsp(B_all, n), _sdsp(S, n),
+        sc4, sc4,
+    )
+    add("deep_denoms_limbres", RES._deep_denoms_p, _sdsp(N), sc4, sc4)
+    add("ext_binv_deep_limbres", lop.ext_batch_inverse_jit, pairp(2, N))
+    deep_blocks: set[int] = set()
+    for B, streamed_src in (
+        (B_wit, stream), (B_setup, stream_setup), (S, stream)
+    ):
+        if streamed_src:
+            for i in range(0, B, COL_BLOCK):
+                b32 = min(COL_BLOCK, B - i)
+                deep_blocks.add(b32)
+                for nm, fn, args in plane_ntt_kernel_specs(
+                    b32, log_n, L, mono=False
+                ):
+                    add(f"deep_regen:{nm}", fn, *args)
+        else:
+            per = max(1, RES._DEEP_BLOCK_BUDGET // (N * 8))
+            for i in range(0, B, per):
+                deep_blocks.add(min(per, B - i))
+    per = max(1, RES._DEEP_BLOCK_BUDGET // (N * 8))
+    for i in range(0, B_q, per):
+        deep_blocks.add(min(per, B_q - i))
+    if smm is not None and not (stream or stream_setup):
+        capE = 2 + num_lk + num_pi
+        add(
+            "deep_codeword_limbres_sm",
+            SS._deep_fn_p(smm, 4, 2, num_lk, num_pi),
+            (_sdsp(B_wit, N), _sdsp(B_setup, N), _sdsp(S, N), _sdsp(B_q, N)),
+            _sdsp(B_all), _sdsp(B_all), _sdsp(B_all), _sdsp(B_all),
+            pairp(N), pairp(N), _sdsp(2, N), _sdsp(2 * num_lk, N),
+            _sdsp(N) if lookups else _sdsp(1), _sdsp(num_pi, N),
+            _sdsp(num_pi, N), _sdsp(num_pi), pairp(2), pairp(num_lk),
+            _sdsp(capE), _sdsp(capE),
+        )
+    else:
+        for b in sorted(deep_blocks):
+            add(
+                f"deep_block_limbres_b{b}", RES._deep_block_p,
+                _sdsp(b, N), _sdsp(b), _sdsp(b),
+            )
+        add(
+            "deep_combine_limbres", RES._deep_combine_p,
+            _sdsp(N), _sdsp(N), _sdsp(B_all), _sdsp(B_all),
+            _sdsp(B_all), _sdsp(B_all), pairp(N),
+        )
+        extras = RES._deep_extras_fn_p(2, num_lk, num_pi)
+        add(
+            "deep_extras_limbres", extras,
+            pairp(N), _sdsp(2, N), _sdsp(2 * num_lk, N), _sdsp(num_pi, N),
+            pairp(N), _sdsp(N) if lookups else _sdsp(1), _sdsp(num_pi, N),
+            pairp(2), pairp(num_lk), _sdsp(num_pi),
+            _sdsp(2 + num_lk + num_pi), _sdsp(2 + num_lk + num_pi),
+        )
+    for nm, fn, args in fri_kernel_specs(n, config, mesh=smm):
+        add(nm, fn, *args)
+
+    # ---- cached plane domain tables' inversions --------------------------
+    from .fri import fold_schedule
+
+    add("binv_domain_limbres", lop.batch_inverse_jit, _sdsp(N))
+    num_folds = sum(
+        fold_schedule(
+            n, config.fri_final_degree,
+            getattr(config, "fri_folding_schedule", None),
+        )
+    )
+    log_full = N.bit_length() - 1
+    for r in range(num_folds):
+        add(
+            f"binv_fold_limbres_r{r}", lop.batch_inverse_jit,
+            _sdsp(1 << (log_full - r - 1)),
+        )
+    if num_pi:
+        add("binv_pi_limbres", lop.batch_inverse_jit, _sdsp(num_pi, N))
+
     seen = set()
     out = []
     for s in specs:

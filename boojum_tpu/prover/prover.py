@@ -193,6 +193,9 @@ def clear_domain_caches():
         fold_challenge_tables,
     ):
         fn.cache_clear()
+    from .resident import clear_plane_caches
+
+    clear_plane_caches()
 
 
 @lru_cache(maxsize=4)
@@ -504,17 +507,22 @@ def _coset_sweep_fn(
     core runs under a plain jit; under a shard_map mesh it runs per chip
     on row shards (parallel/shard_sweep.sweep_shard_map — the terms are
     pointwise across the domain, so sharding rows changes no value)."""
-    from .pallas_sweep import build_coset_terms, limb_sweep_enabled
+    from .pallas_sweep import (
+        build_coset_terms,
+        limb_resident_enabled,
+        limb_sweep_enabled,
+    )
     from ..parallel.sharding import shard_map_mesh
 
     limb = limb_sweep_enabled()
+    resident = limb_resident_enabled()
     if sm_mesh is None:
         sm_mesh = shard_map_mesh()
     cache = getattr(assembly, "_coset_sweep_cache", None)
     if not isinstance(cache, dict):
         cache = {}
         assembly._coset_sweep_cache = cache
-    key = (limb, sm_mesh)
+    key = (limb, resident, sm_mesh)
     if key in cache:
         return cache[key]
 
@@ -533,7 +541,36 @@ def _coset_sweep_fn(
             assembly, selector_paths, non_residues, lk_ctx
         )
 
-    if sm_mesh is not None:
+    if resident:
+        # the RESIDENT sweep: plane stacks in, plane terms out, the
+        # challenge/alpha scalar table host-built (resident.sweep_table_np)
+        core_p = core.planes
+        if sm_mesh is not None:
+            from ..parallel.shard_sweep import sweep_shard_map_p
+
+            fn = sweep_shard_map_p(core_p, sm_mesh)
+        else:
+
+            def body_p(
+                wit_p, setup_p, s2_p, zs_p, c_arr,
+                xs_q_p, l0_q_p, zhinv_q_p, table,
+            ):
+                n = wit_p[0].shape[-1]
+                start = c_arr * n
+
+                def _sl(p):
+                    return (
+                        jax.lax.dynamic_slice_in_dim(p[0], start, n),
+                        jax.lax.dynamic_slice_in_dim(p[1], start, n),
+                    )
+
+                return core_p(
+                    wit_p, setup_p, s2_p, zs_p,
+                    _sl(xs_q_p), _sl(l0_q_p), _sl(zhinv_q_p), table,
+                )
+
+            fn = jax.jit(body_p)
+    elif sm_mesh is not None:
         from ..parallel.shard_sweep import sweep_shard_map
 
         fn = sweep_shard_map(core, sm_mesh)
@@ -796,7 +833,8 @@ def _stream_gather_fused(mono, idx_dev, L: int):
 
 
 def _prefetch_challenge_independent(
-    assembly, setup, config, *, log_n, L, Q, n, lookups, lk_mode
+    assembly, setup, config, *, log_n, L, Q, n, lookups, lk_mode,
+    resident=False,
 ):
     """Round-0 prefetch (BOOJUM_TPU_OVERLAP): every device input and
     cached domain/twiddle table that rounds 2-5 consume and that depends
@@ -810,6 +848,64 @@ def _prefetch_challenge_independent(
 
     from ..ntt.ntt import warm_domain_caches
     from .fri import fold_challenge_tables, fold_schedule
+
+    if resident:
+        # the plane twins of everything below (prover/resident.py) —
+        # same enqueue-only posture, nothing absorbed
+        from . import resident as _RES
+
+        _RES.prefetch_plane_tables(
+            config, log_n=log_n, L=L, Q=Q, n=n, lookups=lookups
+        )
+        if (
+            os.environ.get("BOOJUM_TPU_CACHE_DEVICE_INPUTS", "").strip()
+            == "0"
+        ):
+            return
+        ctx_n = get_ntt_context(log_n)
+        _dev_cached(
+            setup, "sigma_planes",
+            lambda: _RES.host_planes(setup.sigma_cols),
+        )
+        _dev_cached(
+            setup, "xs_h_planes",
+            lambda: _RES.host_planes(gl.powers_np(int(ctx_n.omega), n)),
+        )
+        _dev_cached(
+            setup, "ks_planes",
+            lambda: _RES.host_planes(
+                np.array(
+                    [int(k) for k in setup.non_residues], dtype=np.uint64
+                )
+            ),
+        )
+        _dev_cached(
+            setup, "setup_mono_planes",
+            lambda: _RES.ingest_planes(setup.setup_monomials, "setup_mono"),
+        )
+        if lookups:
+            lp = assembly.lookup_params
+            _dev_cached(
+                assembly, "table_stack_planes",
+                lambda: _RES.host_planes(
+                    assembly.stacked_table_columns(lp.width)
+                ),
+            )
+            _dev_cached(
+                assembly, "mult_planes",
+                lambda: _RES.host_planes(assembly.multiplicities),
+            )
+            if lk_mode == "specialized":
+                _dev_cached(
+                    setup, "tid_planes",
+                    lambda: _RES.host_planes(setup.constant_cols[-1]),
+                )
+            else:
+                _dev_cached(
+                    setup, "consts_planes",
+                    lambda: _RES.host_planes(setup.constant_cols),
+                )
+        return
 
     # twiddle/scale tables: commit rate L, quotient sweep rate Q, and the
     # full-domain brev constants rounds 3/5 read
@@ -1045,6 +1141,36 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # sequenced branches (its smaller jits are what GSPMD partitions).
     sm_mesh = shard_map_mesh()
     fused = active_mesh() is None or sm_mesh is not None
+    # Limb residency (ISSUE 10): with BOOJUM_TPU_LIMB_RESIDENT on, every
+    # fused-round graph below runs its plane twin (prover/resident.py) —
+    # (lo, hi) u32 planes are the canonical device representation from the
+    # H2D witness split to the query-phase host joins, and the interior
+    # u64<->limb conversions of the converting path never trace
+    # (limb.splits/limb.joins stay 0; tests/test_limb_resident.py).
+    from .pallas_sweep import limb_resident_enabled
+    from . import resident as RES
+
+    res = fused and limb_resident_enabled()
+    _wit_key = "witness_planes" if res else "witness_cols"
+
+    def _shard_cols_r(x):
+        if isinstance(x, tuple):
+            return (shard_cols(x[0]), shard_cols(x[1]))
+        return shard_cols(x)
+
+    def _prefetch_r(x):
+        if isinstance(x, tuple):
+            _transfer.prefetch_async(x[0])
+            _transfer.prefetch_async(x[1])
+        else:
+            _transfer.prefetch_async(x)
+
+    def _tree_r(layers):
+        if res:
+            from ..merkle import PlaneMerkleTree
+
+            return PlaneMerkleTree.from_layers(list(layers), cap)
+        return _tree_from_layers(layers, cap)
 
     def _upload_witness():
         host_cols = [np.asarray(assembly.copy_cols_values)]
@@ -1055,8 +1181,10 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         if M:
             host_cols.append(np.asarray(assembly.multiplicities)[None, :])
         # chunked async device_put with overlap on, one synchronous
-        # jnp.asarray(np.concatenate) with it off — identical bytes
-        return _transfer.chunked_upload(host_cols)
+        # jnp.asarray(np.concatenate) with it off — identical bytes.
+        # Resident mode splits once on HOST and uploads u32 planes (the
+        # residency contract's H2D edge).
+        return _transfer.chunked_upload(host_cols, planes=res)
 
     # streamed commit-rate mode: above the footprint threshold the rate-L
     # storages are never materialized — commits absorb column blocks into a
@@ -1089,11 +1217,11 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 ).strip()
                 != "0"
             ):
-                _dev_cached(assembly, "witness_cols", _upload_witness)
+                _dev_cached(assembly, _wit_key, _upload_witness)
             _prefetch_challenge_independent(
                 assembly, setup, config,
                 log_n=log_n, L=L, Q=Q_est, n=n,
-                lookups=lookups, lk_mode=lk_mode,
+                lookups=lookups, lk_mode=lk_mode, resident=res,
             )
 
     t = make_transcript(setup.vk.transcript)
@@ -1105,19 +1233,27 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 1: witness commitment -------------------------------------
     clock.start("round1_witness_commit")
-    witness_cols = _dev_cached(assembly, "witness_cols", _upload_witness)
-    copy_vals = witness_cols[:Ct]
-    witness_cols = shard_cols(witness_cols)
+    witness_cols = _dev_cached(assembly, _wit_key, _upload_witness)
+    if res:
+        copy_vals = (witness_cols[0][:Ct], witness_cols[1][:Ct])
+    else:
+        copy_vals = witness_cols[:Ct]
+    witness_cols = _shard_cols_r(witness_cols)
     # round 2 consumes copy_vals directly: shard it too or the heaviest
     # column phase (grand product + lookup polys) stays replicated
-    copy_vals = shard_cols(copy_vals)
+    copy_vals = _shard_cols_r(copy_vals)
     if fused:
-        wit_mono, wit_lde, layers = _commit_pipeline(
-            witness_cols, L, cap, stream
-        )
+        if res:
+            wit_mono, wit_lde, layers = RES.commit_pipeline_p(
+                witness_cols, L, cap, stream, sm_mesh
+            )
+        else:
+            wit_mono, wit_lde, layers = _commit_pipeline(
+                witness_cols, L, cap, stream
+            )
         if overlap:
-            _transfer.prefetch_async(layers[-1])  # cap d2h rides the queue
-        wit_tree = _tree_from_layers(layers, cap)
+            _prefetch_r(layers[-1])  # cap d2h rides the queue
+        wit_tree = _tree_r(layers)
     else:
         wit_mono = monomial_from_values(witness_cols)
         wit_lde = lde_from_monomial(wit_mono, L)  # (Ct+W+M, L, n)
@@ -1136,13 +1272,105 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
 
     # ---- round 2: copy-permutation + lookup stage 2 ----------------------
     clock.start("round2_stage2_commit")
-    sigma_dev = shard_cols(
-        _dev_cached(setup, "sigma", lambda: jnp.asarray(setup.sigma_cols))
-    )
     chunks = chunk_columns(Ct, geometry.max_allowed_constraint_degree)
     num_partials = len(chunks) - 1
     s2_lde = None
-    if fused:
+    if res:
+        # the plane twins of the fused round-2 graphs (prover/resident.py):
+        # sigma/tables/x-powers enter as HOST-split planes, the chunk scan,
+        # inversions, prefix product and the stage-2 stack all compute in
+        # the limb domain, and the commit pipeline hashes planes
+        from ..field import limb_ops as lop
+
+        ctx_n = get_ntt_context(log_n)
+        sigma_dev = _shard_cols_r(
+            _dev_cached(
+                setup, "sigma_planes",
+                lambda: RES.host_planes(setup.sigma_cols),
+            )
+        )
+        xs_h = _dev_cached(
+            setup, "xs_h_planes",
+            lambda: RES.host_planes(gl.powers_np(int(ctx_n.omega), n)),
+        )
+        ks = _dev_cached(
+            setup, "ks_planes",
+            lambda: RES.host_planes(
+                np.array(
+                    [int(k) for k in setup.non_residues], dtype=np.uint64
+                )
+            ),
+        )
+        bg_arr = jnp.asarray(RES.bg_np(beta, gamma))
+        with _span("stage2_chunk_num_den"):
+            num_all, den_all = RES._all_chunk_num_den_p(
+                copy_vals, sigma_dev, ks, (xs_h, bg_arr),
+                tuple(tuple(c) for c in chunks),
+            )
+            den_inv_all = lop.ext_batch_inverse_jit(den_all)
+        _metrics.count("stage2.chunk_scans")
+        lk_inv = mult_dev = consts_dev = None
+        if lookups:
+            table_stack = _dev_cached(
+                assembly, "table_stack_planes",
+                lambda: RES.host_planes(
+                    assembly.stacked_table_columns(lp.width)
+                ),
+            )
+            mult_dev = _dev_cached(
+                assembly, "mult_planes",
+                lambda: RES.host_planes(assembly.multiplicities),
+            )
+            if lk_mode == "specialized":
+                lkcols = (copy_vals[0][Cg:], copy_vals[1][Cg:])
+                tid_col = _dev_cached(
+                    setup, "tid_planes",
+                    lambda: RES.host_planes(setup.constant_cols[-1]),
+                )
+            else:
+                consts_dev = _dev_cached(
+                    setup, "consts_planes",
+                    lambda: RES.host_planes(setup.constant_cols),
+                )
+                mk_path_r2 = setup.selector_paths[assembly.lookup_marker_gid()]
+                lkcols = (copy_vals[0][:Cg], copy_vals[1][:Cg])
+                tid_col = (
+                    consts_dev[0][len(mk_path_r2)],
+                    consts_dev[1][len(mk_path_r2)],
+                )
+            lkbg_arr = jnp.asarray(RES.bg_np(lookup_beta, lookup_gamma))
+            dens = RES._lookup_denominators_p(
+                lkcols, (tid_col, table_stack), lkbg_arr, R_args, lp.width
+            )
+            lk_inv = lop.ext_batch_inverse_jit(dens)
+        z_pp = RES._z_and_partials_p(num_all, den_inv_all)
+        stack = RES.stage2_stack_fn_p(assembly, setup.selector_paths)
+        s2_vals = stack(z_pp[0], z_pp[1], lk_inv, mult_dev, consts_dev)
+        s2_mono, s2_lde, layers = RES.commit_pipeline_p(
+            s2_vals, L, cap, stream, sm_mesh
+        )
+        del s2_vals
+        if overlap:
+            _prefetch_r(layers[-1])
+        s2_tree = _tree_r(layers)
+        num_all = den_all = den_inv_all = lk_inv = dens = mult_dev = None
+        z_pp = None
+        if stream:
+            for _obj, _keys in (
+                (
+                    assembly,
+                    ("witness_planes", "table_stack_planes", "mult_planes"),
+                ),
+                (setup, ("sigma_planes",)),
+            ):
+                _c = getattr(_obj, "_dev_cache", None)
+                if _c is not None:
+                    for _k in _keys:
+                        _c.pop(_k, None)
+    elif fused:
+        sigma_dev = shard_cols(
+            _dev_cached(setup, "sigma", lambda: jnp.asarray(setup.sigma_cols))
+        )
         from .stages import _all_chunk_num_den, _lookup_denominators
 
         ctx_n = get_ntt_context(log_n)
@@ -1233,6 +1461,9 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                     for _k in _keys:
                         _c.pop(_k, None)
     else:
+        sigma_dev = shard_cols(
+            _dev_cached(setup, "sigma", lambda: jnp.asarray(setup.sigma_cols))
+        )
         z, partials, chunks = compute_copy_permutation_stage2(
             copy_vals, sigma_dev, setup.non_residues, beta, gamma,
             geometry.max_allowed_constraint_degree,
@@ -1297,39 +1528,85 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     # which is what lets 2^20-row traces prove at the Era commit rate L=2.
     clock.start("round3_quotient")
     Q = setup.vk.effective_quotient_degree()
-    if stream:
-        wit_lde_all = MonomialSource(wit_mono, L)
-        s2_lde_flat = MonomialSource(s2_mono, L)
-    else:
-        wit_lde_all = wit_lde.reshape(Ct + W + M, N)
-        s2_lde_flat = s2_lde.reshape(-1, N)
-    # the setup oracle follows HOW IT WAS COMMITTED: a materialized
-    # setup_lde is already resident (and shardable under a mesh) — never
-    # regenerate it; only a streamed-committed setup (setup_lde None)
-    # streams here too
-    if setup.setup_lde is None:
-        setup_lde_flat = MonomialSource(setup.setup_monomials, L)
-    else:
-        setup_lde_flat = shard_cols(setup.setup_lde.reshape(Ct + K + TW, N))
-    xs_lde = _domain_xs_brev(log_n, L)
-    omega = gl.omega(log_n)
-    # per-coset evaluation happens per GROUP (witness / setup / stage-2 /
-    # shifted-z) straight from the existing monomial stacks — concatenating
-    # them would duplicate every committed polynomial's monomials (~1.5 GB
-    # at 2^20 rows) purely for indexing convenience
-    if fused:
-        zs_mono = _zshift_fused(s2_mono[:2], jnp.uint64(omega))
-    else:
-        z_shift_mono = (
-            distribute_powers(s2_mono[0], omega),
-            distribute_powers(s2_mono[1], omega),
-        )
-        zs_mono = jnp.stack([z_shift_mono[0], z_shift_mono[1]])
+    if res:
+        from .streaming import MonomialPlanesSource
 
-    xs_q = _domain_xs_brev(log_n, Q)
-    l0_q = _l0_brev(log_n, Q)
-    zh_inv_q = _vanishing_inv_brev(log_n, Q)
-    scale_q = lde_scale_rows(log_n, Q)
+        _setup_mono_p = _dev_cached(
+            setup, "setup_mono_planes",
+            lambda: RES.ingest_planes(setup.setup_monomials, "setup_mono"),
+        )
+        if stream:
+            wit_lde_all = MonomialPlanesSource(wit_mono, L)
+            s2_lde_flat = MonomialPlanesSource(s2_mono, L)
+        else:
+            wit_lde_all = (
+                wit_lde[0].reshape(Ct + W + M, N),
+                wit_lde[1].reshape(Ct + W + M, N),
+            )
+            s2_lde_flat = (
+                s2_lde[0].reshape(-1, N), s2_lde[1].reshape(-1, N)
+            )
+        if setup.setup_lde is None:
+            setup_lde_flat = MonomialPlanesSource(_setup_mono_p, L)
+        else:
+            setup_lde_flat = _shard_cols_r(
+                _dev_cached(
+                    setup, "setup_lde_planes",
+                    lambda: RES.ingest_planes(
+                        setup.setup_lde.reshape(Ct + K + TW, N), "setup_lde"
+                    ),
+                )
+            )
+        xs_lde = RES.domain_xs_brev_p(log_n, L)
+        omega = gl.omega(log_n)
+        zs_mono = RES._zshift_p(
+            (s2_mono[0][:2], s2_mono[1][:2]), RES.omega_powers_p(log_n)
+        )
+        xs_q = RES.domain_xs_brev_p(log_n, Q)
+        l0_q = RES.l0_brev_p(log_n, Q)
+        zh_inv_q = RES.vanishing_inv_brev_p(log_n, Q)
+        from ..ntt.limb_ntt import _lde_scale_planes
+
+        scale_q = _lde_scale_planes(
+            log_n, Q, int(gl.MULTIPLICATIVE_GENERATOR)
+        )
+    else:
+        if stream:
+            wit_lde_all = MonomialSource(wit_mono, L)
+            s2_lde_flat = MonomialSource(s2_mono, L)
+        else:
+            wit_lde_all = wit_lde.reshape(Ct + W + M, N)
+            s2_lde_flat = s2_lde.reshape(-1, N)
+        # the setup oracle follows HOW IT WAS COMMITTED: a materialized
+        # setup_lde is already resident (and shardable under a mesh) —
+        # never regenerate it; only a streamed-committed setup (setup_lde
+        # None) streams here too
+        if setup.setup_lde is None:
+            setup_lde_flat = MonomialSource(setup.setup_monomials, L)
+        else:
+            setup_lde_flat = shard_cols(
+                setup.setup_lde.reshape(Ct + K + TW, N)
+            )
+        xs_lde = _domain_xs_brev(log_n, L)
+        omega = gl.omega(log_n)
+        # per-coset evaluation happens per GROUP (witness / setup /
+        # stage-2 / shifted-z) straight from the existing monomial stacks
+        # — concatenating them would duplicate every committed
+        # polynomial's monomials (~1.5 GB at 2^20 rows) purely for
+        # indexing convenience
+        if fused:
+            zs_mono = _zshift_fused(s2_mono[:2], jnp.uint64(omega))
+        else:
+            z_shift_mono = (
+                distribute_powers(s2_mono[0], omega),
+                distribute_powers(s2_mono[1], omega),
+            )
+            zs_mono = jnp.stack([z_shift_mono[0], z_shift_mono[1]])
+
+        xs_q = _domain_xs_brev(log_n, Q)
+        l0_q = _l0_brev(log_n, Q)
+        zh_inv_q = _vanishing_inv_brev(log_n, Q)
+        scale_q = lde_scale_rows(log_n, Q)
 
     total_alpha_terms = (
         num_gate_sweep_terms(assembly)
@@ -1349,8 +1626,6 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # five dispatches per coset (4 group evals + 1 terms graph, ~10 ms
         # RTT each) — deliberately NOT one fused graph: the fused form's
         # remote compile alone was ~440s (see _coset_eval_q)
-        ap = AlphaPows(alpha, total_alpha_terms)
-        zero2 = jnp.zeros((2,), jnp.uint64)
         lk_ctx = (
             lookups, lk_mode, R_args, (lp.width if lookups else 0),
             num_partials, tuple(tuple(c) for c in chunks),
@@ -1360,6 +1635,20 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         from .pallas_sweep import limb_sweep_enabled
 
         _limb_sweep = limb_sweep_enabled()
+        if res:
+            # the alpha/γ-power scalar table is host-built; no device u64
+            # challenge arrays exist in the resident round
+            sweep_tb = jnp.asarray(
+                RES.sweep_table_np(
+                    alpha, total_alpha_terms, beta, gamma,
+                    lookup_beta if lookups else (0, 0),
+                    lookup_gamma if lookups else (0, 0),
+                    lookups, (lp.width if lookups else 0),
+                )
+            )
+        else:
+            ap = AlphaPows(alpha, total_alpha_terms)
+            zero2 = jnp.zeros((2,), jnp.uint64)
         sweep = _coset_sweep_fn(
             assembly, setup.selector_paths, setup.non_residues, lk_ctx
         )
@@ -1375,28 +1664,54 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # drive the 2^20 ceiling — bench.py at large traces,
         # scripts/sha2_20_driver.py — set it themselves).
         _sync_sweeps = _transfer.env_flag("BOOJUM_TPU_SYNC_SWEEPS", False)
+        _setup_eval_mono = _setup_mono_p if res else setup.setup_monomials
         if sm_mesh is not None:
             # pad + column-shard the four monomial groups ONCE per round
             # (not per coset); each coset evaluation then runs the
             # per-chip scale+NTT and pivots to row sharding with one
             # explicit all_to_all (parallel/shard_sweep.py)
-            from ..parallel.shard_sweep import (
-                coset_eval_q_sm,
-                pad_cols_sharded,
-            )
-
-            _eval_groups = {
-                "wit": pad_cols_sharded(wit_mono, sm_mesh),
-                "setup": pad_cols_sharded(setup.setup_monomials, sm_mesh),
-                "s2": pad_cols_sharded(s2_mono, sm_mesh),
-                "zs": pad_cols_sharded(zs_mono, sm_mesh),
-            }
-
-            def _eval_group(tag, mono_stack, ci):
-                return coset_eval_q_sm(
-                    _eval_groups[tag], scale_q, ci,
-                    int(mono_stack.shape[0]), sm_mesh,
+            if res:
+                from ..parallel.shard_sweep import (
+                    coset_eval_q_sm_p,
+                    pad_cols_sharded_p,
                 )
+
+                _eval_groups = {
+                    "wit": pad_cols_sharded_p(wit_mono, sm_mesh),
+                    "setup": pad_cols_sharded_p(_setup_eval_mono, sm_mesh),
+                    "s2": pad_cols_sharded_p(s2_mono, sm_mesh),
+                    "zs": pad_cols_sharded_p(zs_mono, sm_mesh),
+                }
+
+                def _eval_group(tag, mono_stack, ci):
+                    return coset_eval_q_sm_p(
+                        _eval_groups[tag], scale_q, ci,
+                        int(mono_stack[0].shape[0]), sm_mesh,
+                    )
+
+            else:
+                from ..parallel.shard_sweep import (
+                    coset_eval_q_sm,
+                    pad_cols_sharded,
+                )
+
+                _eval_groups = {
+                    "wit": pad_cols_sharded(wit_mono, sm_mesh),
+                    "setup": pad_cols_sharded(_setup_eval_mono, sm_mesh),
+                    "s2": pad_cols_sharded(s2_mono, sm_mesh),
+                    "zs": pad_cols_sharded(zs_mono, sm_mesh),
+                }
+
+                def _eval_group(tag, mono_stack, ci):
+                    return coset_eval_q_sm(
+                        _eval_groups[tag], scale_q, ci,
+                        int(mono_stack.shape[0]), sm_mesh,
+                    )
+
+        elif res:
+
+            def _eval_group(tag, mono_p, ci):
+                return RES._coset_eval_q_p(mono_p, scale_q, ci)
 
         else:
 
@@ -1406,7 +1721,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         T_parts0, T_parts1 = [], []
         with _span(
             "round3_coset_sweeps", cosets=Q, limb=_limb_sweep,
-            sm=sm_mesh is not None,
+            resident=res, sm=sm_mesh is not None,
         ):
             for c in range(Q):
                 ci = jnp.int32(c)
@@ -1417,17 +1732,25 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                     # count makes "which representation ran" auditable
                     # per report
                     _metrics.count("quotient.limb_coset_sweeps")
+                if res:
+                    _metrics.count("quotient.resident_coset_sweeps")
                 wit_v = _eval_group("wit", wit_mono, ci)
-                setup_v = _eval_group("setup", setup.setup_monomials, ci)
+                setup_v = _eval_group("setup", _setup_eval_mono, ci)
                 s2_v = _eval_group("s2", s2_mono, ci)
                 zs_v = _eval_group("zs", zs_mono, ci)
-                t0c, t1c = sweep(
-                    wit_v, setup_v, s2_v, zs_v,
-                    ci, xs_q, l0_q, zh_inv_q,
-                    ap.p0, ap.p1, beta01, gamma01,
-                    lkb01 if lkb01 is not None else zero2,
-                    lkg01 if lkg01 is not None else zero2,
-                )
+                if res:
+                    t0c, t1c = sweep(
+                        wit_v, setup_v, s2_v, zs_v,
+                        ci, xs_q, l0_q, zh_inv_q, sweep_tb,
+                    )
+                else:
+                    t0c, t1c = sweep(
+                        wit_v, setup_v, s2_v, zs_v,
+                        ci, xs_q, l0_q, zh_inv_q,
+                        ap.p0, ap.p1, beta01, gamma01,
+                        lkb01 if lkb01 is not None else zero2,
+                        lkg01 if lkg01 is not None else zero2,
+                    )
                 if _sync_sweeps:
                     _metrics.count("host.blocking_syncs")
                     jax.block_until_ready(t1c)
@@ -1436,20 +1759,34 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             _sync_point(T_parts1, "round3_sweeps")
         if sm_mesh is not None:
             del _eval_groups
-            from ..parallel.shard_sweep import commit_from_mono_sm
+            if res:
+                from ..parallel.shard_sweep import commit_from_mono_sm_p
 
-            q_mono = _quotient_interp(
-                tuple(T_parts0), tuple(T_parts1), Q, n
+                q_mono = RES._quotient_interp_p(
+                    tuple(T_parts0), tuple(T_parts1), Q, n
+                )
+                q_lde, layers = commit_from_mono_sm_p(
+                    q_mono, L, cap, sm_mesh
+                )
+            else:
+                from ..parallel.shard_sweep import commit_from_mono_sm
+
+                q_mono = _quotient_interp(
+                    tuple(T_parts0), tuple(T_parts1), Q, n
+                )
+                q_lde, layers = commit_from_mono_sm(q_mono, L, cap, sm_mesh)
+        elif res:
+            q_mono, q_lde, layers = RES._quotient_tail_p(
+                tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
             )
-            q_lde, layers = commit_from_mono_sm(q_mono, L, cap, sm_mesh)
         else:
             q_mono, q_lde, layers = _quotient_tail_fused(
                 tuple(T_parts0), tuple(T_parts1), Q, n, L, cap
             )
         del T_parts0, T_parts1
         if overlap:
-            _transfer.prefetch_async(layers[-1])
-        q_tree = _tree_from_layers(layers, cap)
+            _prefetch_r(layers[-1])
+        q_tree = _tree_r(layers)
     else:
         T_parts0, T_parts1 = [], []
         for c in range(Q):
@@ -1553,11 +1890,54 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         s2_mono = _demesh(s2_mono)
         q_mono = _demesh(q_mono)
         _setup_mono = _demesh(_setup_mono)
-    all_mono = jnp.concatenate([wit_mono, _setup_mono, s2_mono, q_mono])
-    B = all_mono.shape[0]
+    if res:
+        all_mono = (
+            jnp.concatenate(
+                [wit_mono[0], _setup_mono_p[0], s2_mono[0], q_mono[0]]
+            ),
+            jnp.concatenate(
+                [wit_mono[1], _setup_mono_p[1], s2_mono[1], q_mono[1]]
+            ),
+        )
+        B = all_mono[0].shape[0]
+    else:
+        all_mono = jnp.concatenate([wit_mono, _setup_mono, s2_mono, q_mono])
+        B = all_mono.shape[0]
     zw = ext_f.mul_by_base_s(z_chal, omega)
     deep_prep = None
-    if fused:
+    if res:
+        # evaluations compute on planes; the pull fetches u32 planes and
+        # u64 reassembles ON HOST (the transcript absorb edge)
+        z_tb = jnp.asarray(RES.ext_sc_np(z_chal))
+        zw_tb = jnp.asarray(RES.ext_sc_np(zw))
+        ev0p, ev1p, evw0p, evw1p = RES._evals_p(
+            all_mono, s2_mono, z_tb, zw_tb
+        )
+        pulls = [
+            ev0p[0], ev0p[1], ev1p[0], ev1p[1],
+            evw0p[0], evw0p[1], evw1p[0], evw1p[1],
+        ]
+        if lookups:
+            pulls += [s2_mono[0][:, 0], s2_mono[1][:, 0]]
+        fetch = _transfer.start_fetch(pulls, label="round4_evals")
+        if overlap:
+            with _span("deep_prep_overlap"):
+                deep_prep = RES.deep_round5_prep_p(
+                    assembly, log_n=log_n, L=L, N=N, lookups=lookups,
+                    num_partials=num_partials, R_args=R_args,
+                    s2_mono_p=s2_mono, wit_mono_p=wit_mono,
+                    s2_lde_flat_p=s2_lde_flat, wit_lde_all_p=wit_lde_all,
+                    xs_lde_p=xs_lde, z_tb=z_tb, zw_tb=zw_tb, omega=omega,
+                )
+        got = fetch.wait()
+        from ..field.limbs import join_np as _join_np
+
+        ev0 = _join_np(got[0], got[1])
+        ev1 = _join_np(got[2], got[3])
+        evw0 = _join_np(got[4], got[5])
+        evw1 = _join_np(got[6], got[7])
+        s2_mono_host = _join_np(got[8], got[9]) if lookups else None
+    elif fused:
         z01 = jnp.asarray(np.array([z_chal[0], z_chal[1]], dtype=np.uint64))
         zw01 = jnp.asarray(np.array([zw[0], zw[1]], dtype=np.uint64))
         ev0, ev1, evw0, evw1 = _evals_fused(all_mono, s2_mono, z01, zw01)
@@ -1657,24 +2037,105 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         wit_lde_all,
         setup_lde_flat,
         s2_lde_flat,
-        q_lde.reshape(2 * Q, N),
+        (
+            (q_lde[0].reshape(2 * Q, N), q_lde[1].reshape(2 * Q, N))
+            if res
+            else q_lde.reshape(2 * Q, N)
+        ),
     ]
     num_deep_terms = (
         B + 2
         + ((R_args + 1) if lookups else 0)
         + len(assembly.public_inputs)
     )
-    deep_pows = AlphaPows(deep_ch, num_deep_terms)
-    c0s, c1s = deep_pows.take(B)
-    y0s = jnp.asarray(
-        np.array([v[0] for v in values_at_z], dtype=np.uint64)
-    )
-    y1s = jnp.asarray(
-        np.array([v[1] for v in values_at_z], dtype=np.uint64)
-    )
     num_lk = (R_args + 1) if lookups else 0
     num_pi = len(assembly.public_inputs)
-    if fused:
+    if res:
+        # DEEP challenge powers + opened values enter as HOST-built planes
+        from .streaming import MonomialPlanesSource
+
+        dp = ext_f.powers_s(
+            (int(deep_ch[0]), int(deep_ch[1])), RES._next_pow2(num_deep_terms)
+        )
+        dp0 = np.array([p[0] for p in dp], dtype=np.uint64)
+        dp1 = np.array([p[1] for p in dp], dtype=np.uint64)
+        c0s = RES.host_planes(dp0[:B])
+        c1s = RES.host_planes(dp1[:B])
+        y0s = RES.host_planes(
+            np.array([v[0] for v in values_at_z], dtype=np.uint64)
+        )
+        y1s = RES.host_planes(
+            np.array([v[1] for v in values_at_z], dtype=np.uint64)
+        )
+        if deep_prep is None:
+            deep_prep = RES.deep_round5_prep_p(
+                assembly, log_n=log_n, L=L, N=N, lookups=lookups,
+                num_partials=num_partials, R_args=R_args,
+                s2_mono_p=s2_mono, wit_mono_p=wit_mono,
+                s2_lde_flat_p=s2_lde_flat, wit_lde_all_p=wit_lde_all,
+                xs_lde_p=xs_lde, z_tb=z_tb, zw_tb=zw_tb, omega=omega,
+            )
+        inv_xz = deep_prep["inv_xz"]
+        inv_xzw = deep_prep["inv_xzw"]
+        E = 2 + num_lk + num_pi
+        ch0e = RES.host_planes(dp0[B : B + E])
+        ch1e = RES.host_planes(dp1[B : B + E])
+        y_zw = (
+            RES.host_planes(
+                np.array([v[0] for v in values_at_z_omega], dtype=np.uint64)
+            ),
+            RES.host_planes(
+                np.array([v[1] for v in values_at_z_omega], dtype=np.uint64)
+            ),
+        )
+        y_lk0 = (
+            RES.host_planes(
+                np.array([v[0] for v in values_at_0], dtype=np.uint64)
+            ),
+            RES.host_planes(
+                np.array([v[1] for v in values_at_0], dtype=np.uint64)
+            ),
+        )
+        _streamed_deep = any(
+            isinstance(s, MonomialPlanesSource) for s in deep_sources
+        )
+        if sm_mesh is not None and not _streamed_deep:
+            from ..parallel.shard_sweep import deep_codeword_sm_p
+
+            h = deep_codeword_sm_p(
+                sm_mesh, deep_sources, y0s, y1s, c0s, c1s, inv_xz,
+                deep_prep, y_zw, y_lk0, ch0e, ch1e, 2, num_lk, num_pi,
+            )
+        else:
+            if sm_mesh is not None:
+                from ..parallel.shard_sweep import demesh as _demesh
+
+                deep_sources = [_demesh(s) for s in deep_sources]
+                deep_prep = {k: _demesh(v) for k, v in deep_prep.items()}
+                inv_xz = deep_prep["inv_xz"]
+                inv_xzw = deep_prep["inv_xzw"]
+            h = RES._deep_main_sum_p(
+                deep_sources, y0s, y1s, c0s, c1s, inv_xz
+            )
+            s2_cols = deep_prep["s2_cols"]
+            cols_zw = (s2_cols[0][:2], s2_cols[1][:2])
+            cols_lk = (s2_cols[0][2:], s2_cols[1][2:])
+            extras = RES._deep_extras_fn_p(2, num_lk, num_pi)
+            h = extras(
+                h, cols_zw, cols_lk, deep_prep["cols_pi"], inv_xzw,
+                deep_prep["inv_x"], deep_prep["pi_denoms"],
+                y_zw, y_lk0, deep_prep["pi_vals"], ch0e, ch1e,
+            )
+        _metrics.count("deep.resident_codewords")
+    elif fused:
+        deep_pows = AlphaPows(deep_ch, num_deep_terms)
+        c0s, c1s = deep_pows.take(B)
+        y0s = jnp.asarray(
+            np.array([v[0] for v in values_at_z], dtype=np.uint64)
+        )
+        y1s = jnp.asarray(
+            np.array([v[1] for v in values_at_z], dtype=np.uint64)
+        )
         # the challenge-independent prep — 1/(x-z), 1/(x-z*omega) (one
         # build + ONE batched inversion), single-column regens for the
         # remaining terms, public-input denominators — was dispatched
@@ -1745,6 +2206,14 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
                 y_zw, y_lk0, pi_vals, ch0e, ch1e,
             )
     else:
+        deep_pows = AlphaPows(deep_ch, num_deep_terms)
+        c0s, c1s = deep_pows.take(B)
+        y0s = jnp.asarray(
+            np.array([v[0] for v in values_at_z], dtype=np.uint64)
+        )
+        y1s = jnp.asarray(
+            np.array([v[1] for v in values_at_z], dtype=np.uint64)
+        )
         # 1/(x - z), 1/(x - z*omega) over the domain (ext)
         x_minus_z = (gf.sub(xs_lde, jnp.uint64(z_chal[0])),
                      jnp.broadcast_to(jnp.uint64(gl.neg(z_chal[1])), xs_lde.shape))
@@ -1827,25 +2296,50 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         plan_shapes.append(shape)
         return len(plans) - 1, shape
 
-    def _defer_oracle(leaves_cols, tree):
+    def _defer_vals(leaves_cols):
+        """Leaf-value gather handle: ("one", h) for u64 storages, or
+        ("pair", h_lo, h_hi) for resident plane pairs — the pair joins on
+        HOST in _take_vals (the query-opening edge of the residency
+        contract; no device u64 ever exists)."""
+        from .streaming import MonomialPlanesSource
+
         if isinstance(leaves_cols, MonomialSource):
             vals = _stream_gather_fused(
                 leaves_cols.mono, idx_dev, leaves_cols.L
             )
-            vals_h = _defer(vals, None, 2)
-        else:
-            vals_h = _defer(leaves_cols, idx_dev, 1)
+            return ("one", _defer(vals, None, 2))
+        if isinstance(leaves_cols, MonomialPlanesSource):
+            vlo, vhi = RES._stream_gather_p(
+                leaves_cols.mono, idx_dev, leaves_cols.L
+            )
+            return ("pair", _defer(vlo, None, 2), _defer(vhi, None, 2))
+        if isinstance(leaves_cols, tuple):
+            return (
+                "pair",
+                _defer(leaves_cols[0], idx_dev, 1),
+                _defer(leaves_cols[1], idx_dev, 1),
+            )
+        return ("one", _defer(leaves_cols, idx_dev, 1))
+
+    def _defer_oracle(leaves_cols, tree):
+        vals_h = _defer_vals(leaves_cols)
         gplans, assemble = tree.proof_gather_plans(idxs)
         level_hs = [
             _defer(layer, jnp.asarray(ix), 0) for layer, ix in gplans
         ]
         return vals_h, level_hs, assemble
 
+    if res:
+        _q_flat = (q_lde[0].reshape(2 * Q, N), q_lde[1].reshape(2 * Q, N))
+        _setup_tree = RES.setup_tree_planes(setup)
+    else:
+        _q_flat = q_lde.reshape(2 * Q, N)
+        _setup_tree = setup.setup_tree
     oracle_handles = [
         _defer_oracle(wit_lde_all, wit_tree),
         _defer_oracle(s2_lde_flat, s2_tree),
-        _defer_oracle(q_lde.reshape(2 * Q, N), q_tree),
-        _defer_oracle(setup_lde_flat, setup.setup_tree),
+        _defer_oracle(_q_flat, q_tree),
+        _defer_oracle(setup_lde_flat, _setup_tree),
     ]
     fri_handles = []
     fidxs = np.array(idxs, dtype=np.int64)
@@ -1858,8 +2352,14 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
             leaf_idx[:, None] * block + np.arange(block)[None, :]
         ).reshape(-1)
         rows_dev = jnp.asarray(rows)
-        g0_h = _defer(v0, rows_dev, 0)
-        g1_h = _defer(v1, rows_dev, 0)
+        if res:
+            g0_h = ("pair", _defer(v0[0], rows_dev, 0),
+                    _defer(v0[1], rows_dev, 0))
+            g1_h = ("pair", _defer(v1[0], rows_dev, 0),
+                    _defer(v1[1], rows_dev, 0))
+        else:
+            g0_h = ("one", _defer(v0, rows_dev, 0))
+            g1_h = ("one", _defer(v1, rows_dev, 0))
         gplans, assemble = tree.proof_gather_plans(
             [int(p) for p in leaf_idx]
         )
@@ -1911,9 +2411,16 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         i, shape = handle
         return flat[_plan_offsets[i] : _plan_offsets[i + 1]].reshape(shape)
 
+    def _take_vals(handle):
+        if handle[0] == "one":
+            return _take(handle[1])
+        from ..field.limbs import join_np as _join_np
+
+        return _join_np(_take(handle[1]), _take(handle[2]))
+
     def _oracle_queries(handle):
         vals_h, level_hs, assemble = handle
-        vals = _take(vals_h)
+        vals = _take_vals(vals_h)
         paths = assemble([_take(h) for h in level_hs])
         return [
             OracleQuery(
@@ -1926,7 +2433,7 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
     fri_qs_per_round = []
     num_q = len(idxs)
     for g0_h, g1_h, level_hs, assemble, block in fri_handles:
-        gathered = np.stack([_take(g0_h), _take(g1_h)])  # (2, Q*block)
+        gathered = np.stack([_take_vals(g0_h), _take_vals(g1_h)])
         paths = assemble([_take(h) for h in level_hs])
         fri_qs_per_round.append(
             [

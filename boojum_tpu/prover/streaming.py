@@ -161,7 +161,7 @@ def streamed_leaf_digests_blocks(mono, L: int):
     )[:, :4]
 
 
-def double_buffered_absorb(state, starts, produce_cols):
+def double_buffered_absorb(state, starts, produce_cols, absorb=None):
     """The double-buffered absorb loop shared by the meshless streamed
     commit above and the per-chip shard_map one
     (parallel/shard_sweep.streamed_leaf_digests_sm): block b+1's leaf
@@ -169,9 +169,13 @@ def double_buffered_absorb(state, starts, produce_cols):
     BEFORE block b's absorb, so the device pipelines transforms against
     the serial sponge chain. `produce_cols(start)` must return the (N, b)
     leaf columns for the block at `start`; absorb order — and therefore
-    every digest — is identical to the sequential loop."""
+    every digest — is identical to the sequential loop. `absorb` swaps
+    the per-block absorb kernel (the limb-resident commit passes its
+    plane twin); default is the u64 `_absorb_cols`."""
     from ..utils import metrics as _metrics
 
+    if absorb is None:
+        absorb = _absorb_cols
     starts = list(starts)
     nxt = produce_cols(starts[0])
     for k in range(len(starts)):
@@ -179,7 +183,7 @@ def double_buffered_absorb(state, starts, produce_cols):
             produce_cols(starts[k + 1]) if k + 1 < len(starts) else None
         )
         _metrics.count("stream.double_buffered_blocks")
-        state = _absorb_cols(state, cols)
+        state = absorb(state, cols)
     return state
 
 
@@ -234,6 +238,132 @@ def _absorb_lde_block(state, mono_blk, L: int):
             state, jnp.concatenate([cols[:, b - rem :], pad], axis=1)
         )
     return state
+
+
+# ---------------------------------------------------------------------------
+# Limb-plane streamed commit (ISSUE 10): the double-buffered blocks carry
+# (lo, hi) u32 planes end-to-end — LDE, pivot-to-rows and the carried
+# sponge state never materialize u64. Digest values are identical.
+# ---------------------------------------------------------------------------
+
+
+class MonomialPlanesSource:
+    """MonomialSource twin over plane monomials: stands in for a resident
+    oracle's materialized (B, L*n) plane pair in the DEEP/query phases."""
+
+    def __init__(self, mono_p, L: int):
+        self.mono = mono_p
+        self.L = int(L)
+
+    @property
+    def shape(self):
+        return (self.mono[0].shape[0], self.mono[0].shape[-1] * self.L)
+
+    def blocks(self, per: int = COL_BLOCK):
+        from ..ntt.limb_ntt import lde_from_monomial_p
+
+        B = self.mono[0].shape[0]
+        for i in range(0, B, per):
+            blk = (self.mono[0][i : i + per], self.mono[1][i : i + per])
+            lde = lde_from_monomial_p(blk, self.L)
+            b = lde[0].shape[0]
+            yield i, (lde[0].reshape(b, -1), lde[1].reshape(b, -1))
+
+    def column(self, i: int):
+        from ..ntt.limb_ntt import lde_from_monomial_p
+
+        blk = (self.mono[0][i : i + 1], self.mono[1][i : i + 1])
+        lde = lde_from_monomial_p(blk, self.L)
+        return lde[0].reshape(-1), lde[1].reshape(-1)
+
+    def gather_rows(self, idx_dev):
+        parts = [
+            (flat[0][:, idx_dev], flat[1][:, idx_dev])
+            for _, flat in self.blocks()
+        ]
+        return (
+            jnp.concatenate([p[0] for p in parts], axis=0),
+            jnp.concatenate([p[1] for p in parts], axis=0),
+        )
+
+
+@jax.jit
+def _sponge_absorb8_p(state_p, chunk8_p):
+    from ..hashes.poseidon2 import poseidon2_permutation_planes
+
+    st = (
+        jnp.concatenate([chunk8_p[0], state_p[0][:, 8:]], axis=-1),
+        jnp.concatenate([chunk8_p[1], state_p[1][:, 8:]], axis=-1),
+    )
+    return poseidon2_permutation_planes(st)
+
+
+@jax.jit
+def _absorb_cols_p(state_p, cols_p):
+    """Plane twin of _absorb_cols (same chunk/finalize semantics)."""
+    b = cols_p[0].shape[1]
+    for k in range(b // 8):
+        state_p = _sponge_absorb8_p(
+            state_p,
+            (cols_p[0][:, 8 * k : 8 * k + 8], cols_p[1][:, 8 * k : 8 * k + 8]),
+        )
+    rem = b % 8
+    if rem:
+        pad = jnp.zeros((cols_p[0].shape[0], 8 - rem), jnp.uint32)
+        state_p = _sponge_absorb8_p(
+            state_p,
+            (
+                jnp.concatenate([cols_p[0][:, b - rem :], pad], axis=1),
+                jnp.concatenate([cols_p[1][:, b - rem :], pad], axis=1),
+            ),
+        )
+    return state_p
+
+
+@_partial(jax.jit, static_argnums=(1,))
+def _lde_block_cols_p(mono_blk_p, L: int):
+    """Plane twin of _lde_block_cols: (b, n) monomial planes ->
+    (N, b) leaf-column planes."""
+    from ..ntt.limb_ntt import lde_from_monomial_p
+
+    b = mono_blk_p[0].shape[0]
+    lde = lde_from_monomial_p(mono_blk_p, L)
+    return lde[0].reshape(b, -1).T, lde[1].reshape(b, -1).T
+
+
+def streamed_leaf_digests_blocks_p(mono_p, L: int):
+    """Plane twin of streamed_leaf_digests_blocks: (N, 4) digest planes,
+    double-buffered under BOOJUM_TPU_OVERLAP exactly like the u64 form."""
+    from ..utils.transfer import overlap_enabled
+
+    assert COL_BLOCK % 8 == 0
+    n = mono_p[0].shape[-1]
+    B = mono_p[0].shape[0]
+    state = (
+        jnp.zeros((n * L, 12), jnp.uint32),
+        jnp.zeros((n * L, 12), jnp.uint32),
+    )
+
+    def _blk(i):
+        b = min(COL_BLOCK, B - i)
+        return (
+            jax.lax.dynamic_slice_in_dim(mono_p[0], i, b, axis=0),
+            jax.lax.dynamic_slice_in_dim(mono_p[1], i, b, axis=0),
+        )
+
+    if not overlap_enabled():
+        for i in range(0, B, COL_BLOCK):
+            cols = _lde_block_cols_p(_blk(i), L)
+            state = _absorb_cols_p(state, cols)
+        return state[0][:, :4], state[1][:, :4]
+
+    state = double_buffered_absorb(
+        state,
+        range(0, B, COL_BLOCK),
+        lambda i: _lde_block_cols_p(_blk(i), L),
+        absorb=_absorb_cols_p,
+    )
+    return state[0][:, :4], state[1][:, :4]
 
 
 def commit_streaming(mono, L: int, cap_size: int) -> MerkleTreeWithCap:

@@ -115,6 +115,50 @@ class DeviceCacheManager:
             if key in self._warmed_geometries:
                 return False
             self._warmed_geometries.add(key)
+        from ..prover.pallas_sweep import limb_resident_enabled
+
+        if limb_resident_enabled():
+            # the resident prove consumes the PLANE table set (ISSUE 10)
+            # — warm exactly what it will touch, nothing u64
+            from ..prover import resident as RES
+            from ..ntt import limb_ntt as LN
+            from ..field import gl as _gl
+
+            # plane twiddle contexts for trace size and both full-domain
+            # rates (the warm_domain_caches twin)
+            LN.PlaneNTTContext(bucket.log_n)
+            LN.PlaneNTTContext(
+                bucket.log_n + (bucket.lde_factor.bit_length() - 1)
+            )
+            LN.PlaneNTTContext(
+                bucket.log_n + (bucket.quotient_degree.bit_length() - 1)
+            )
+            RES.domain_xs_brev_p(bucket.log_n, bucket.lde_factor)
+            RES.domain_xs_brev_p(bucket.log_n, bucket.quotient_degree)
+            RES.l0_brev_p(bucket.log_n, bucket.quotient_degree)
+            RES.vanishing_inv_brev_p(bucket.log_n, bucket.quotient_degree)
+            RES.omega_powers_p(bucket.log_n)
+            LN._lde_scale_planes(
+                bucket.log_n, bucket.lde_factor,
+                int(_gl.MULTIPLICATIVE_GENERATOR),
+            )
+            LN._lde_scale_planes(
+                bucket.log_n, bucket.quotient_degree,
+                int(_gl.MULTIPLICATIVE_GENERATOR),
+            )
+            if bucket.lookups:
+                RES.inv_xs_brev_p(bucket.log_n, bucket.lde_factor)
+            from ..prover.fri import fold_challenge_tables_p, fold_schedule
+
+            log_full = bucket.log_n + (bucket.lde_factor.bit_length() - 1)
+            num_folds = sum(
+                fold_schedule(
+                    bucket.trace_len, bucket.fri_final_degree,
+                    list(bucket.fri_schedule) or None,
+                )
+            )
+            fold_challenge_tables_p(log_full, num_folds)
+            return True
         from ..ntt.ntt import warm_domain_caches
         from ..prover.fri import fold_challenge_tables, fold_schedule
         from ..prover.prover import _inv_xs_brev

@@ -156,11 +156,17 @@ def gauge_add(name: str, v: float):
 def count_upload(x):
     """Tally a fresh host->device upload of a device array `x` (the
     prover's explicit upload seams — prover._dev_cached, the sequenced
-    stage-2 table uploads); passes `x` through."""
+    stage-2 table uploads); passes `x` through. A (lo, hi) limb plane
+    pair (the resident prove's upload unit) counts both planes."""
     reg = current_registry()
     if reg is not None:
         try:
-            count_bytes_h2d(int(x.size) * x.dtype.itemsize)
+            if isinstance(x, tuple):
+                count_bytes_h2d(
+                    sum(int(a.size) * a.dtype.itemsize for a in x)
+                )
+            else:
+                count_bytes_h2d(int(x.size) * x.dtype.itemsize)
         except Exception:
             pass
     return x

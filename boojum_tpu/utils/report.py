@@ -511,6 +511,30 @@ def validate_report(report: dict) -> list[str]:
                         f"misses (real compiles escaped the artifact "
                         f"store)"
                     )
+        # limb.* — the u64<->limb conversion tax (ISSUE 10). Counters
+        # must be finite non-negative ints, and a line whose kernels
+        # claim LIMB-RESIDENT dispatch (quotient.resident_coset_sweeps /
+        # fri.resident_folds) while counting INTERIOR splits/joins is
+        # lying about residency — the whole point of the resident mode
+        # is that those are zero (edges are allowlisted under
+        # limb.edge_*/limb.host_*).
+        for k, v in counters.items():
+            if not k.startswith("limb."):
+                continue
+            if not isinstance(v, int) or v < 0:
+                problems.append(f"limb metric {k}: invalid value {v!r}")
+        resident_claimed = (
+            _num(counters.get("quotient.resident_coset_sweeps", 0)) > 0
+            or _num(counters.get("fri.resident_folds", 0)) > 0
+        )
+        if resident_claimed:
+            for k in ("limb.splits", "limb.joins"):
+                if _num(counters.get(k, 0)) > 0:
+                    problems.append(
+                        f"resident-mode prove counted interior {k} = "
+                        f"{counters.get(k)} (conversions must survive "
+                        f"only at allowlisted edges)"
+                    )
     # per-request SLO record (proving-service lines): the record the
     # --slo summary and dashboards key on — a request line missing its
     # queue latency or placement is unusable for SLO accounting and
@@ -759,6 +783,7 @@ def slo_summary(reports: list[dict]) -> dict:
     # phases, bench warm-ups) — the deployment-health axis the AOT
     # bundle store adds
     aot_hits = aot_misses = 0
+    resident_lines = 0
     for r in reports:
         c = (r.get("metrics") or {}).get("counters") or {}
         if isinstance(c, dict):
@@ -767,8 +792,14 @@ def slo_summary(reports: list[dict]) -> dict:
             # junk line must not kill the whole --slo summary
             aot_hits += h if isinstance(h, (int, float)) else 0
             aot_misses += m if isinstance(m, (int, float)) else 0
+            rs = c.get("quotient.resident_coset_sweeps", 0)
+            if isinstance(rs, (int, float)) and rs > 0:
+                resident_lines += 1
 
     return {
+        # which representation served: lines whose kernels dispatched
+        # limb-RESIDENT (ISSUE 10) — BENCH/SLO deltas are attributable
+        "limb_resident_lines": resident_lines,
         "requests": len(reqs),
         "served": len(ok),
         "failed": len(reqs) - len(ok),
@@ -811,6 +842,11 @@ def render_slo(summary: dict) -> str:
         lines.append(
             f"  aot artifacts {summary['aot_hit_rate']} hit rate over "
             f"{summary['aot_kernels_warmed']} warmed kernels"
+        )
+    if summary.get("limb_resident_lines"):
+        lines.append(
+            f"  limb-resident {summary['limb_resident_lines']} lines "
+            f"dispatched the resident kernel set"
         )
     if summary.get("placements"):
         lines.append(
@@ -857,6 +893,11 @@ def render_report(report: dict, top: int = 10) -> str:
                 extras += f" occ={100 * sp['sync_s'] / w:.0f}%"
         if sp.get("overlap_s"):
             extras += f" ovl={sp['overlap_s']:.3f}s"
+        attrs = sp.get("attrs")
+        if isinstance(attrs, dict) and attrs.get("resident"):
+            # the limb-residency flag (ISSUE 10): which representation
+            # this span's kernels computed in, visible in the tree
+            extras += " resident"
         if sp.get("error"):
             extras += f" ERROR={sp['error']!r}"
         lines.append(

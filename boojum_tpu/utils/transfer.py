@@ -246,7 +246,7 @@ def _concat_jit():
     return _CONCAT_JIT
 
 
-def chunked_upload(host_arrays):
+def chunked_upload(host_arrays, planes: bool = False):
     """Upload a list of (rows_i, n) host arrays as one (sum_rows, n)
     device stack.
 
@@ -254,11 +254,39 @@ def chunked_upload(host_arrays):
     `jax.device_put` (async enqueue — the host returns to transcript work
     while the DMA runs) and ONE jitted on-device concatenate joins them;
     bit-identical to uploading the host-side concatenation. Overlap off:
-    exactly the legacy single synchronous `jnp.asarray(np.concatenate)`."""
+    exactly the legacy single synchronous `jnp.asarray(np.concatenate)`.
+
+    With `planes` (the limb-resident prove, ISSUE 10) each chunk splits
+    ONCE on host (`limbs.split_np` — the H2D edge of the residency
+    contract) and uploads as two u32 planes; returns the (lo, hi) device
+    pair. Same chunk walk, same total bytes."""
     import jax
     import jax.numpy as jnp
 
     host_arrays = [np.asarray(a) for a in host_arrays]
+    if planes:
+        from ..field import limbs
+
+        split_arrays = [limbs.split_np(a) for a in host_arrays]
+        if not overlap_enabled():
+            if len(split_arrays) == 1:
+                lo, hi = split_arrays[0]
+                return jnp.asarray(lo), jnp.asarray(hi)
+            return (
+                jnp.asarray(np.concatenate([s[0] for s in split_arrays])),
+                jnp.asarray(np.concatenate([s[1] for s in split_arrays])),
+            )
+        n = host_arrays[0].shape[-1]
+        per = max(1, H2D_CHUNK_BYTES // max(n * 8, 1))
+        parts_lo, parts_hi = [], []
+        for lo, hi in split_arrays:
+            for i in range(0, lo.shape[0], per):
+                parts_lo.append(jax.device_put(lo[i : i + per]))
+                parts_hi.append(jax.device_put(hi[i : i + per]))
+        _metrics.count("transfer.h2d_chunks", 2 * len(parts_lo))
+        if len(parts_lo) == 1:
+            return parts_lo[0], parts_hi[0]
+        return _concat_jit()(*parts_lo), _concat_jit()(*parts_hi)
     if not overlap_enabled():
         if len(host_arrays) == 1:
             return jnp.asarray(host_arrays[0])

@@ -123,6 +123,73 @@ def test_limb_sweep_kernels_enumerate_and_lower(monkeypatch):
     monkeypatch.setenv("BOOJUM_TPU_LIMB_SWEEP", "0")
     names_u64 = [s.name for s in enumerate_kernels(asm, cfg)]
     assert "coset_sweep_terms" in names_u64
+
+
+def test_limb_resident_kernels_enumerate_and_lower(monkeypatch):
+    """ISSUE 10 satellite: with BOOJUM_TPU_LIMB_RESIDENT=1 the enumeration
+    swaps to the RESIDENT plane-kernel set (`*_limbres` ledger names —
+    plane NTTs, plane sponges/commits, the resident sweep and FRI chain,
+    the stage-2/DEEP plane twins), it LOWERS on CPU, and the converting
+    names disappear (only the dispatched variant is enumerated)."""
+    from boojum_tpu.cs.gates import FmaGate, PublicInputGate
+    from boojum_tpu.prover.precompile import enumerate_kernels, precompile
+
+    monkeypatch.setenv("BOOJUM_TPU_LIMB_RESIDENT", "1")
+    geom = CSGeometry(8, 0, 6, 4)
+    cs = ConstraintSystem(geom, 1 << 10)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geom)
+    for _ in range(((1 << 10) - 8) * per_row):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    asm = cs.into_assembly()
+    cfg = ProofConfig(
+        fri_lde_factor=2,
+        merkle_tree_cap_size=4,
+        num_queries=4,
+        fri_final_degree=16,
+    )
+    specs = enumerate_kernels(asm, cfg)
+    names = [s.name for s in specs]
+    assert "coset_sweep_terms_limbres" in names
+    assert "coset_sweep_terms" not in names
+    assert "coset_sweep_terms_limb" not in names
+    res_folds = [n for n in names if n.startswith("fri_fold_limbres_")]
+    assert res_folds, names
+    assert not any(n.startswith("fri_fold_k") for n in names)
+    assert "chunk_num_den_limbres" in names
+    assert "z_and_partials_limbres" in names
+    assert "evals_limbres" in names
+    assert "deep_combine_limbres" in names
+    assert "node_layers_limbres" in names
+    assert any(n.startswith("wit:imono_limbres_") for n in names), names
+    assert any(n.startswith("wit:lde_limbres_") for n in names), names
+    # every resident spec lowers cleanly on CPU
+    ledger = CompileLedger()
+    precompile(asm, cfg, ledger=ledger, lower_only=True)
+    by_name = {e["name"]: e for e in ledger.entries}
+    for name in (
+        ["coset_sweep_terms_limbres", "chunk_num_den_limbres",
+         "z_and_partials_limbres", "evals_limbres",
+         "deep_combine_limbres", "deep_extras_limbres",
+         "node_layers_limbres", "quotient_interp_limbres",
+         "deep_denoms_limbres", "zshift_limbres"]
+        + res_folds
+    ):
+        assert name in by_name, name
+        assert "error" not in by_name[name], by_name[name]
+
+    # the AOT bundle key separates the variants (a resident bundle must
+    # never serve a converting process)
+    from boojum_tpu.prover.aot import variant_fingerprint
+
+    assert variant_fingerprint()["limb_resident"] is True
+    monkeypatch.setenv("BOOJUM_TPU_LIMB_RESIDENT", "0")
+    assert variant_fingerprint()["limb_resident"] is False
+    names_u64 = [s.name for s in enumerate_kernels(asm, cfg)]
+    assert "coset_sweep_terms" in names_u64
+    assert "coset_sweep_terms_limbres" not in names_u64
     assert "coset_sweep_terms_limb" not in names_u64
 
 
