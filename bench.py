@@ -848,14 +848,63 @@ def main():
             f"service drain: {nreq} requests, "
             f"mesh={None if svc.mesh is None else dict(svc.mesh.shape)}"
         )
-        requests = [
-            svc.submit(
-                asm, setup, config,
-                priority="interactive" if i == nreq - 1 else "batch",
+        if os.environ.get("BENCH_SERVICE_GATEWAY", "").strip() in (
+            "1", "true", "on", "yes"
+        ):
+            # ISSUE 11: admit over the real loopback HTTP front door so
+            # the measured proofs/sec includes the network admission
+            # plane (auth, quota check, DRR queue) — two equal-weight
+            # tenants split the request stream
+            import urllib.request
+
+            from boojum_tpu.service import (
+                Gateway, GatewayConfig, TenantSpec,
             )
-            for i in range(nreq)
-        ]
-        summary = svc.run_worker()
+
+            gw = Gateway(
+                svc,
+                GatewayConfig(tenants=[
+                    TenantSpec(id="bench-a", token="bench-a"),
+                    TenantSpec(id="bench-b", token="bench-b"),
+                ]),
+                resolver=lambda spec: (asm, setup, config),
+            )
+            port = gw.start()
+            _log(f"service drain: gateway admission on :{port}")
+            drain_t0 = time.perf_counter()
+            jobs = []
+            for i in range(nreq):
+                r = urllib.request.Request(
+                    gw.url("/prove"),
+                    data=json.dumps({
+                        "priority": (
+                            "interactive" if i == nreq - 1 else "batch"
+                        ),
+                    }).encode(),
+                    headers={
+                        "Authorization":
+                            f"Bearer bench-{'ab'[i % 2]}",
+                        "Content-Type": "application/json",
+                    },
+                    method="POST",
+                )
+                with urllib.request.urlopen(r, timeout=30) as resp:
+                    jobs.append(json.loads(resp.read())["job"])
+            # worker drains in the gateway's background thread
+            requests = gw.wait_jobs(jobs, timeout_s=3600)
+            drain_wall = time.perf_counter() - drain_t0
+            gw.stop()
+            summary = svc.summary(wall_s=drain_wall)
+            summary["gateway_admitted"] = len(jobs)
+        else:
+            requests = [
+                svc.submit(
+                    asm, setup, config,
+                    priority="interactive" if i == nreq - 1 else "batch",
+                )
+                for i in range(nreq)
+            ]
+            summary = svc.run_worker()
         assert summary["failed"] == 0, summary
         for r in requests:
             r.result(timeout=1.0)

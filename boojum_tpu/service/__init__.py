@@ -14,12 +14,20 @@ Public surface:
 - `MetricsPlane` — the stdlib HTTP telemetry endpoint
   (http_metrics.py: /metrics Prometheus text, /healthz, /slo), started
   by the worker loop when `ServiceConfig.metrics_port` is set.
+- `Gateway` / `GatewayConfig` — the network admission plane
+  (gateway.py, ISSUE 11): POST /prove with tenant auth + idempotency
+  keys, job status/proof download, graceful drain, hot AOT reload and
+  telemetry-driven load-shed, composed with the read plane under one
+  server.
+- `TenantSpec` / `QuotaLedger` / `parse_tenant_specs` — tenant
+  identity, DRR weights and per-window byte/compute quotas (tenant.py).
 
-Driver CLI: `scripts/prove_service.py`; bench integration:
-`bench.py --service`.
+Driver CLI: `scripts/prove_service.py` (`--gateway` serves the front
+door); bench integration: `bench.py --service`.
 """
 
 from .cache import DeviceCacheManager
+from .gateway import Gateway, GatewayConfig, GatewayJob, read_spool
 from .http_metrics import MetricsPlane
 from .queue import LANES, AdmissionQueue, QueueFullError
 from .scheduler import (
@@ -29,10 +37,14 @@ from .scheduler import (
     choose_placement,
 )
 from .service import ProveRequest, ProvingService, ServiceConfig
+from .tenant import QuotaLedger, TenantSpec, parse_tenant_specs
 
 __all__ = [
     "AdmissionQueue",
     "DeviceCacheManager",
+    "Gateway",
+    "GatewayConfig",
+    "GatewayJob",
     "LANES",
     "MetricsPlane",
     "Placement",
@@ -40,7 +52,11 @@ __all__ = [
     "ProveRequest",
     "ProvingService",
     "QueueFullError",
+    "QuotaLedger",
     "SHARD_PARALLEL",
     "ServiceConfig",
+    "TenantSpec",
     "choose_placement",
+    "parse_tenant_specs",
+    "read_spool",
 ]

@@ -1,0 +1,811 @@
+"""Network admission plane: the HTTP front door of the proving service.
+
+ISSUE 11's tentpole. Until now admission was an in-process Python
+`submit()`; millions of users hit a port. This module puts a
+stdlib-only HTTP **write plane** in front of `service/queue.py`,
+composed with the existing read-only plane (`service/http_metrics.py`)
+under ONE server:
+
+  POST /prove                submit a job spec (JSON body). Auth is a
+                             shared-secret bearer token mapped to a
+                             tenant id; an `Idempotency-Key` header
+                             makes the submit replay-safe — a replay
+                             returns the ORIGINAL ticket/proof from the
+                             gateway's ledger and never re-proves.
+                             Responses: 202 ticket, 200 replay,
+                             401 bad token, 400 bad spec, 429 quota
+                             exhausted (Retry-After = window reset),
+                             503 queue full / draining / bulk load-shed.
+  GET  /jobs/<id>            ticket status (+ the request's SLO record
+                             once served). Tenants see only their own
+                             jobs; admin tokens see all.
+  GET  /jobs/<id>/proof      the proof bytes, streamed in 64 KiB chunks.
+  POST /admin/drain          graceful drain: stop admitting (503),
+                             finish in-flight work, flush report lines,
+                             stop the worker loop; the `drained` event
+                             lets a serving CLI exit.
+  POST /admin/reload-artifacts
+                             hot AOT-bundle reload: forget every warm
+                             key so the next batch per bucket re-runs
+                             the artifact-store load (prover/aot.py)
+                             against the CURRENT bundle dir — without
+                             dropping the queue.
+  GET  /metrics /healthz /slo  delegated to MetricsPlane.handle_get —
+                             identical bodies to the standalone plane.
+
+Fairness + quotas ride the components ISSUE 11 added around this
+module: the gateway configures per-tenant DRR weights on the admission
+queue (`queue.py`), installs a `tenant.QuotaLedger` on the service
+(charged from each request's flight-recorder record), and registers the
+ledger's snapshot as a telemetry provider so `service.tenant.*` usage
+rides /metrics and every report line's `telemetry` record. Load-shed is
+telemetry-driven: bulk-lane work is rejected while queue depth or the
+device-memory high-water gauge is above the configured thresholds.
+
+Rejected admissions (429/shed) append a minimal report line carrying a
+`tenant` record with `rejected` set — `prove_report.py --check`
+enforces that such lines never carry a prove wall, and `--slo` counts
+them per tenant.
+
+A `spool_dir` turns the gateway into DIZK-style work distribution: bulk
+jobs are written as one JSON file per request into the spool instead of
+being proved locally, and `scripts/multihost_worker.py` "proofs" mode
+feeds each worker its `distribute_proofs` slice of the spool — the
+horizontal tier's feed path from this front door.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils import report as _report
+from ..utils.profiling import log as _log
+from .http_metrics import MetricsPlane
+from .queue import LANES, QueueFullError
+from .tenant import QuotaLedger, TenantSpec, parse_tenant_specs
+
+# streamed-download chunk: large enough to amortize syscalls, small
+# enough that a slow client never pins a proof-sized buffer per write
+STREAM_CHUNK = 64 * 1024
+
+
+def _env_opt_int(name: str) -> int | None:
+    v = os.environ.get(name, "").strip()
+    if not v:
+        return None
+    return int(float(v))
+
+
+@dataclass
+class GatewayConfig:
+    """Knobs of one Gateway (env: BOOJUM_TPU_GATEWAY_*)."""
+
+    tenants: list = field(default_factory=list)  # list[TenantSpec]
+    host: str = "127.0.0.1"       # loopback posture, like the read plane
+    port: int = 0                 # BOOJUM_TPU_GATEWAY_PORT (0 = any free)
+    admin_token: str | None = None  # BOOJUM_TPU_GATEWAY_ADMIN_TOKEN
+    quota_window_s: float = 60.0  # BOOJUM_TPU_GATEWAY_QUOTA_WINDOW_S
+    # telemetry-driven load-shed thresholds (None = axis disabled):
+    # bulk-lane admissions are rejected 503 while crossed
+    shed_queue_depth: int | None = None   # BOOJUM_TPU_GATEWAY_SHED_DEPTH
+    shed_mem_bytes: int | None = None     # BOOJUM_TPU_GATEWAY_SHED_MEM_BYTES
+    # bulk-lane spool directory (None = prove bulk work locally)
+    spool_dir: str | None = None  # BOOJUM_TPU_GATEWAY_SPOOL
+    # ticket/idempotency ledger bound: above it the oldest FINISHED
+    # jobs (and their idempotency keys) are evicted — a long-running
+    # front door must not retain every proof ever served. Each
+    # finished ticket pins its PROOF for replay/download (assembly/
+    # setup refs are shared with the resolver's memoized parts), so
+    # size this to proofs-worth-of-RAM you can hold: 2048 × a ~1 MB
+    # proof ≈ 2 GiB ceiling at the default
+    max_jobs: int = 2048          # BOOJUM_TPU_GATEWAY_MAX_JOBS
+    drain_timeout_s: float = 600.0
+    worker_idle_wait_s: float = 0.2
+
+    @classmethod
+    def from_env(cls) -> "GatewayConfig":
+        return cls(
+            tenants=parse_tenant_specs(
+                os.environ.get("BOOJUM_TPU_GATEWAY_TENANTS", "")
+            ),
+            port=_env_opt_int("BOOJUM_TPU_GATEWAY_PORT") or 0,
+            admin_token=(
+                os.environ.get("BOOJUM_TPU_GATEWAY_ADMIN_TOKEN") or None
+            ),
+            quota_window_s=float(
+                os.environ.get("BOOJUM_TPU_GATEWAY_QUOTA_WINDOW_S") or 60.0
+            ),
+            shed_queue_depth=_env_opt_int("BOOJUM_TPU_GATEWAY_SHED_DEPTH"),
+            shed_mem_bytes=_env_opt_int("BOOJUM_TPU_GATEWAY_SHED_MEM_BYTES"),
+            spool_dir=os.environ.get("BOOJUM_TPU_GATEWAY_SPOOL") or None,
+            max_jobs=_env_opt_int("BOOJUM_TPU_GATEWAY_MAX_JOBS") or 2048,
+        )
+
+
+@dataclass
+class GatewayJob:
+    """One admitted ticket: the gateway's unit of idempotency and
+    status. `req` is None for spooled (farmed-out) jobs."""
+
+    id: str
+    tenant: str
+    spec: dict
+    req: object = None            # ProveRequest | None
+    idem_key: str | None = None
+    spooled: bool = False
+    created_ts: float = 0.0
+
+    def status(self) -> str:
+        if self.spooled:
+            return "spooled"
+        if self.req is None or not self.req.done():
+            return "queued"
+        return "failed" if self.req.error is not None else "done"
+
+
+def read_spool(spool_dir: str) -> list:
+    """[(filename, spec_dict), ...] over the gateway spool, sorted by
+    filename (admission order: names embed the monotonically-increasing
+    job id). Partial/corrupt files — a gateway mid-write crashed — are
+    skipped; the atomic tmp+rename on the write side makes that rare.
+    Shared with scripts/multihost_worker.py "proofs" mode."""
+    out = []
+    for fname in sorted(os.listdir(spool_dir)):
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(spool_dir, fname)) as f:
+                out.append((fname, json.load(f)))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+class Gateway:
+    """The HTTP admission plane over one ProvingService.
+
+    `resolver(spec) -> (assembly, setup, config)` turns a job spec into
+    prove parts — the CLI (scripts/prove_service.py) passes its circuit
+    catalog; tests pass a registry of prebuilt parts. The gateway owns
+    the worker loop (a daemon thread draining the service) for its
+    lifetime, so `start()` is the whole deployment: bind, drain, serve.
+    """
+
+    def __init__(self, service, config: GatewayConfig, resolver):
+        self.service = service
+        self.config = config
+        self.resolver = resolver
+        by_token = {}
+        for t in config.tenants:
+            if t.token in by_token:
+                raise ValueError(
+                    f"tenants {by_token[t.token].id!r} and {t.id!r} share "
+                    f"a token — tokens must be unique"
+                )
+            by_token[t.token] = t
+        self._by_token: dict[str, TenantSpec] = by_token
+        # per-tenant fairness + quotas onto the service's components
+        service.quota = QuotaLedger(
+            config.tenants, window_s=config.quota_window_s
+        )
+        for t in config.tenants:
+            service.queue.set_weight(t.id, t.weight)
+        service.sampler.add_provider(
+            "service.tenant", service.quota.snapshot
+        )
+        # read plane: rendering only — never start()ed; its endpoints are
+        # served by THIS gateway's server via handle_get
+        self.read_plane = MetricsPlane(
+            service.sampler,
+            health_fn=service._telemetry_health,
+            slo_fn=service._telemetry_slo,
+        )
+        self._jobs: dict[str, GatewayJob] = {}
+        self._idem: dict[tuple[str, str], str] = {}
+        self._ids = itertools.count(1)
+        self._lock = threading.Lock()
+        self._drain_lock = threading.Lock()
+        self._draining = threading.Event()
+        self.drained = threading.Event()
+        self._stop_worker = threading.Event()
+        self._worker: threading.Thread | None = None
+        self._server: ThreadingHTTPServer | None = None
+        self._http_thread: threading.Thread | None = None
+        self.port: int | None = None
+        if config.spool_dir:
+            os.makedirs(config.spool_dir, exist_ok=True)
+
+    # ---- lifecycle -------------------------------------------------------
+    def start(self) -> int:
+        """Start telemetry + the worker loop + the HTTP server; returns
+        the bound port."""
+        if self._server is not None:
+            return self.port
+        # sampler only — no standalone plane even when the service
+        # config carries a metrics_port: /metrics rides THIS server
+        self.service.start_telemetry(sampler_only=True)
+        self._stop_worker.clear()
+        self._worker = threading.Thread(
+            target=self._worker_main, name="boojum-gateway-worker",
+            daemon=True,
+        )
+        self._worker.start()
+        gw = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # quiet by default
+                pass
+
+            def _send(self, code, body, ctype, extra_headers=None):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (extra_headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                # streamed write: proof downloads go out in chunks so a
+                # multi-MB proof never sits behind one giant write
+                for i in range(0, len(body), STREAM_CHUNK):
+                    self.wfile.write(body[i:i + STREAM_CHUNK])
+
+            def _dispatch(self, method):
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                    body = self.rfile.read(length) if length else b""
+                    out = gw.handle(method, self.path, self.headers, body)
+                    self._send(*out)
+                except (BrokenPipeError, ConnectionError):
+                    pass  # client went away: not a server error
+                except Exception as e:  # noqa: BLE001 — an admission
+                    # failure must be a 500 body + a counted error, never
+                    # a dropped connection or a dead server
+                    gw.read_plane.count_error()
+                    try:
+                        self._send(
+                            500,
+                            json.dumps({"error": repr(e)}).encode(),
+                            "application/json",
+                        )
+                    except Exception:
+                        pass
+
+            def do_GET(self):   # noqa: N802 — http.server API
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+        self._server = ThreadingHTTPServer(
+            (self.config.host, self.config.port), _Handler
+        )
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._http_thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="boojum-gateway-http", daemon=True,
+        )
+        self._http_thread.start()
+        _log(
+            f"gateway: admission plane up on "
+            f"http://{self.config.host}:{self.port} "
+            f"({len(self._by_token)} tenants)"
+        )
+        return self.port
+
+    def _worker_main(self):
+        try:
+            self.service.run_worker(
+                stop=self._stop_worker,
+                idle_wait_s=self.config.worker_idle_wait_s,
+            )
+        except Exception as e:  # noqa: BLE001 — keep the port answering
+            _log(f"gateway: worker loop died: {e!r}")
+
+    def stop(self):
+        """Tear everything down (idempotent); drain() is the graceful
+        path — this one just stops."""
+        srv = self._server
+        if srv is not None:
+            self._server = None
+            srv.shutdown()
+            srv.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(timeout=5.0)
+                self._http_thread = None
+        self._stop_worker.set()
+        if self._worker is not None:
+            self._worker.join(timeout=10.0)
+            self._worker = None
+        self.service.stop_telemetry()
+
+    def url(self, path: str = "") -> str:
+        return f"http://{self.config.host}:{self.port}{path}"
+
+    # ---- routing (socket-free: unit-testable) ----------------------------
+    def handle(self, method, path, headers, body):
+        """Route one request: (code, body_bytes, ctype[, extra_headers]).
+        Pure of sockets so tests can drive the plane without binding."""
+        path = path.split("?", 1)[0].rstrip("/") or "/"
+        if method == "GET":
+            out = self.read_plane.handle_get(path)
+            if out is not None:
+                return out
+            if path.startswith("/jobs/"):
+                return self._get_job(path, headers)
+            return self._json(404, {"error": "not found"})
+        if method == "POST":
+            if path == "/prove":
+                return self._post_prove(headers, body)
+            if path == "/admin/drain":
+                return self._admin(headers, self._drain_locked)
+            if path == "/admin/reload-artifacts":
+                return self._admin(headers, self._admin_reload)
+            return self._json(404, {"error": "not found"})
+        return self._json(405, {"error": f"method {method} not allowed"})
+
+    @staticmethod
+    def _json(code, obj, extra_headers=None):
+        body = json.dumps(obj).encode()
+        if extra_headers:
+            return code, body, "application/json", extra_headers
+        return code, body, "application/json"
+
+    @staticmethod
+    def _token(headers) -> str:
+        tok = headers.get("X-Boojum-Token") or ""
+        if not tok:
+            auth = headers.get("Authorization") or ""
+            if auth.startswith("Bearer "):
+                tok = auth[len("Bearer "):].strip()
+        return tok
+
+    def _auth(self, headers) -> TenantSpec | None:
+        tok = self._token(headers)
+        return self._by_token.get(tok) if tok else None
+
+    def _is_admin(self, headers, tenant: TenantSpec | None) -> bool:
+        """Admin = a tenant carrying the admin flag, or the standalone
+        BOOJUM_TPU_GATEWAY_ADMIN_TOKEN (which needs no tenant row)."""
+        if tenant is not None and tenant.admin:
+            return True
+        admin_tok = self.config.admin_token
+        return admin_tok is not None and self._token(headers) == admin_tok
+
+    def _count(self, name: str, n: int = 1):
+        """Gateway counters live on the sampler's registry so they ride
+        /metrics (boojum_tpu_service_gateway_*)."""
+        try:
+            self.service.sampler.registry.count(name, n)
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ---- POST /prove -----------------------------------------------------
+    def _post_prove(self, headers, body):
+        tenant = self._auth(headers)
+        if tenant is None:
+            self._count("service.gateway.auth_failures")
+            return self._json(401, {"error": "unknown or missing token"})
+        # idempotency FIRST: a replay is a LEDGER READ — it must return
+        # the original ticket before draining/quotas/shedding get a
+        # chance to answer differently, and must never re-prove. The
+        # check and the reservation happen under ONE lock acquisition:
+        # two concurrent POSTs with the same key race to reserve, the
+        # loser replays the winner's (possibly still queued) ticket —
+        # never a second prove or a double quota charge.
+        idem = headers.get("Idempotency-Key") or None
+        with self._lock:
+            if idem is not None:
+                existing = self._idem.get((tenant.id, idem))
+                if existing is not None and existing in self._jobs:
+                    job = self._jobs[existing]
+                    if job.req is None and not job.spooled:
+                        # the winner is still BETWEEN reservation and
+                        # admission — its checks may yet roll the
+                        # reservation back, so a 200 here could hand
+                        # out a ticket that then evaporates. Tell the
+                        # duplicate to retry instead.
+                        return self._json(
+                            409,
+                            {
+                                "error": "original request with this "
+                                         "key is still being admitted",
+                                "retry_after_s": 1,
+                            },
+                            {"Retry-After": "1"},
+                        )
+                    self._count("service.gateway.replays")
+                    return self._json(
+                        200, dict(self._ticket(job), replay=True)
+                    )
+            job_id = f"gw-{next(self._ids):06d}"
+            job = GatewayJob(
+                id=job_id, tenant=tenant.id, spec={}, idem_key=idem,
+                created_ts=time.time(),
+            )
+            self._jobs[job_id] = job
+            if idem is not None:
+                self._idem[(tenant.id, idem)] = job_id
+        # every path below either fills the reservation in (202) or
+        # rolls it back (_unreserve) so a rejected key can be retried
+        if self._draining.is_set():
+            self._unreserve(job)
+            return self._json(
+                503, {"error": "draining", "retry_after_s": 30},
+                {"Retry-After": "30"},
+            )
+        try:
+            spec = json.loads(body.decode() or "{}")
+            if not isinstance(spec, dict):
+                raise ValueError("job spec must be a JSON object")
+        except ValueError as e:
+            self._unreserve(job)
+            return self._json(400, {"error": f"bad job spec: {e}"})
+        priority = spec.get("priority", "batch")
+        if priority not in LANES:
+            self._unreserve(job)
+            return self._json(
+                400,
+                {"error": f"unknown priority {priority!r}: use {LANES}"},
+            )
+
+        ok, retry_after = self.service.quota.admit(tenant.id)
+        if not ok:
+            self._unreserve(job)
+            self._count("service.gateway.throttled")
+            self._reject_line(tenant.id, "throttled", 429, retry_after)
+            return self._json(
+                429,
+                {
+                    "error": "quota exhausted",
+                    "tenant": tenant.id,
+                    "retry_after_s": round(retry_after, 3),
+                },
+                {"Retry-After": str(max(1, int(retry_after + 0.999)))},
+            )
+        if priority == "bulk" and self._should_shed():
+            self._unreserve(job)
+            self._count("service.gateway.shed")
+            self._reject_line(tenant.id, "shed", 503, None)
+            return self._json(
+                503,
+                {"error": "bulk lane shedding load", "tenant": tenant.id},
+                {"Retry-After": "30"},
+            )
+
+        if priority == "bulk" and self.config.spool_dir:
+            nbytes = self._spool_job(job, tenant, spec)
+            # spooled work never reaches _serve_one's settle, so the
+            # byte quota is charged HERE (spool-file bytes; the fleet
+            # owns the compute) — without this a quota tenant could
+            # fill the spool disk unmetered
+            try:
+                self.service.quota.charge(tenant.id, nbytes, 0.0)
+            except Exception:  # noqa: BLE001
+                pass
+            self._count("service.gateway.spooled")
+            self._gc_jobs()
+            return self._json(202, self._ticket(job))
+        try:
+            asm, setup, cfg = self.resolver(spec)
+        except Exception as e:  # noqa: BLE001 — a spec the resolver
+            # rejects is the CLIENT's error
+            self._unreserve(job)
+            return self._json(400, {"error": f"unresolvable spec: {e!r}"})
+        try:
+            req = self.service.submit(
+                asm, setup, cfg,
+                priority=priority,
+                tenant=tenant.id,
+                request_id=job_id,
+                capture_trace=bool(spec.get("capture_trace")),
+                gateway=True,
+            )
+        except QueueFullError:
+            self._unreserve(job)
+            return self._json(
+                503,
+                {"error": "admission queue full", "retry_after_s": 5},
+                {"Retry-After": "5"},
+            )
+        with self._lock:
+            job.spec = spec
+            job.req = req
+        self._count("service.gateway.admitted")
+        self._gc_jobs()
+        return self._json(202, self._ticket(job))
+
+    def _unreserve(self, job: GatewayJob):
+        """Roll a rejected admission's ticket/idempotency reservation
+        back so the client can retry the same key later."""
+        with self._lock:
+            self._jobs.pop(job.id, None)
+            if job.idem_key is not None:
+                key = (job.tenant, job.idem_key)
+                if self._idem.get(key) == job.id:
+                    del self._idem[key]
+
+    def _gc_jobs(self):
+        """Bound the ticket ledger above max_jobs, oldest first (dict
+        insertion order is admission order). FINISHED tickets
+        (done/failed) go first; only if the ledger is still over does
+        it fall back to the oldest SPOOLED tickets (their record of
+        truth is the spool file / the fleet's result line, and keeping
+        them forever would be the unbounded-growth hole this GC
+        exists to close). Locally-queued tickets are NEVER evicted."""
+        with self._lock:
+            excess = len(self._jobs) - self.config.max_jobs
+            if excess <= 0:
+                return
+
+            def evict(statuses):
+                nonlocal excess
+                for job_id in list(self._jobs):
+                    if excess <= 0:
+                        return
+                    job = self._jobs[job_id]
+                    if job.status() not in statuses:
+                        continue
+                    del self._jobs[job_id]
+                    if job.idem_key is not None:
+                        key = (job.tenant, job.idem_key)
+                        if self._idem.get(key) == job_id:
+                            del self._idem[key]
+                    excess -= 1
+
+            evict(("done", "failed"))
+            evict(("spooled",))
+
+    def _ticket(self, job: GatewayJob) -> dict:
+        out = {
+            "job": job.id,
+            "tenant": job.tenant,
+            "status": job.status(),
+            "priority": job.spec.get("priority", "batch"),
+        }
+        if job.req is not None and job.req.done():
+            out["request"] = dict(job.req.slo)
+            if job.req.error is not None:
+                out["error"] = repr(job.req.error)
+        return out
+
+    def _spool_job(self, job: GatewayJob, tenant, spec):
+        """Farm a bulk job out to the worker fleet: one JSON file per
+        request in the spool dir (atomic tmp+rename), named by job id so
+        spool order is admission order."""
+        record = dict(spec)
+        record["job"] = job.id
+        record["tenant"] = tenant.id
+        path = os.path.join(self.config.spool_dir, f"{job.id}.json")
+        tmp = path + ".tmp"
+        payload = json.dumps(record)
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+        with self._lock:
+            job.spec = spec
+            job.spooled = True
+        return len(payload)
+
+    def _should_shed(self) -> bool:
+        """Telemetry-driven load-shed: bulk work is rejected while queue
+        depth or the device-memory high-water gauge is above threshold."""
+        cfg = self.config
+        if (
+            cfg.shed_queue_depth is not None
+            and self.service.queue.depth() >= cfg.shed_queue_depth
+        ):
+            return True
+        if cfg.shed_mem_bytes is not None:
+            gauges = (
+                self.service.sampler.registry.to_dict().get("gauges") or {}
+            )
+            high_water = max(
+                gauges.get("telemetry.device_bytes_in_use_high_water", 0),
+                gauges.get("telemetry.live_bytes_high_water", 0),
+            )
+            if high_water >= cfg.shed_mem_bytes:
+                return True
+        return False
+
+    def _reject_line(self, tenant_id, reason, code, retry_after):
+        """Append a minimal report line for a rejected admission so the
+        artifact carries the 429/shed history `--slo` aggregates. The
+        line has NO request record (nothing was proved — --check
+        enforces that a rejected line never carries a prove wall)."""
+        path = self.service.report_path
+        if not path:
+            return
+        tenant_rec = {"id": tenant_id, "rejected": code, "reason": reason}
+        if retry_after is not None:
+            tenant_rec["retry_after_s"] = round(max(0.0, retry_after), 3)
+        line = {
+            "kind": _report.REPORT_KIND,
+            "schema": _report.REPORT_SCHEMA,
+            "label": f"gateway:{reason}",
+            "unix_ts": round(time.time(), 3),
+            "wall_s": 0.0,
+            "spans": [],
+            "metrics": {
+                "counters": {f"service.gateway.{reason}": 1},
+                "gauges": {},
+            },
+            "checkpoints": [],
+            "tenant": tenant_rec,
+        }
+        try:
+            with self.service._report_lock:
+                _report.append_jsonl(path, line)
+        except Exception as e:  # noqa: BLE001
+            _log(f"gateway: reject line write failed: {e!r}")
+
+    # ---- GET /jobs/<id>[/proof] ------------------------------------------
+    def _get_job(self, path, headers):
+        tenant = self._auth(headers)
+        is_admin = self._is_admin(headers, tenant)
+        if tenant is None and not is_admin:
+            self._count("service.gateway.auth_failures")
+            return self._json(401, {"error": "unknown or missing token"})
+        parts = path.split("/")  # ['', 'jobs', '<id>'(, 'proof')]
+        job_id = parts[2] if len(parts) > 2 else ""
+        want_proof = len(parts) > 3 and parts[3] == "proof"
+        with self._lock:
+            job = self._jobs.get(job_id)
+        if job is None or (
+            not is_admin and (tenant is None or job.tenant != tenant.id)
+        ):
+            # a foreign tenant's ticket is indistinguishable from a
+            # nonexistent one: no cross-tenant job-id probing
+            return self._json(404, {"error": f"no job {job_id!r}"})
+        if not want_proof:
+            return self._json(200, self._ticket(job))
+        status = job.status()
+        if status != "done":
+            code = 500 if status == "failed" else 409
+            return self._json(code, self._ticket(job))
+        proof_bytes = job.req.proof.to_json().encode()
+        return (
+            200, proof_bytes, "application/json",
+            {"X-Boojum-Job": job.id},
+        )
+
+    # ---- admin verbs -----------------------------------------------------
+    def _admin(self, headers, verb):
+        tenant = self._auth(headers)
+        if not self._is_admin(headers, tenant):
+            # a KNOWN tenant probing admin verbs is an authorization
+            # denial, not a credential failure — keep the bad-token
+            # alarm (auth_failures) clean of it
+            self._count(
+                "service.gateway.admin_denied" if tenant is not None
+                else "service.gateway.auth_failures"
+            )
+            return self._json(403, {"error": "admin token required"})
+        return verb()
+
+    def drain(self) -> dict:
+        """Public graceful-drain entry (the /admin/drain verb and the
+        CLI's SIGINT path both land here; serialized so a concurrent
+        pair can't double-join the worker). Returns the drain body."""
+        return json.loads(self._drain_locked()[1])
+
+    def _drain_locked(self):
+        with self._drain_lock:
+            return self._admin_drain()
+
+    def job(self, job_id: str) -> GatewayJob | None:
+        """Ticket lookup by id (public: harness/bench surface)."""
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def wait_jobs(self, job_ids, timeout_s: float | None = None,
+                  poll_s: float = 0.2) -> list:
+        """Block until every listed LOCALLY-PROVED job finishes;
+        returns their ProveRequests in job_ids order. Spooled jobs
+        (proved by the fleet) raise ValueError — the gateway never
+        learns their completion. TimeoutError past timeout_s."""
+        deadline = (
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+        out = []
+        for job_id in job_ids:
+            job = self.job(job_id)
+            if job is None:
+                raise KeyError(f"no job {job_id!r}")
+            if job.spooled:
+                raise ValueError(
+                    f"job {job_id!r} was spooled to the fleet"
+                )
+            while job.req is None or not job.req.done():
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"job {job_id!r} still {job.status()}"
+                    )
+                time.sleep(poll_s)
+            out.append(job.req)
+        return out
+
+    def _admin_drain(self):
+        """Graceful drain: stop admitting, finish in-flight work, flush
+        the report artifact, stop the worker loop. Blocks until drained
+        (or the timeout), then sets `drained` so a serving CLI exits."""
+        self._draining.set()
+        self._count("service.gateway.drains")
+        deadline = time.monotonic() + self.config.drain_timeout_s
+        svc = self.service
+
+        def busy():
+            # _serve_lock covers the whole batch — including the window
+            # between pop_batch (queue depth already 0) and the first
+            # _inflight increment (scheduling + variant warming), which
+            # depth/inflight alone would misread as idle
+            return (
+                svc.queue.depth() > 0
+                or svc._inflight > 0
+                or svc._serve_lock.locked()
+            )
+
+        while busy() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        timed_out = busy()
+        self._stop_worker.set()
+        if self._worker is not None:
+            self._worker.join(timeout=30.0)
+            if self._worker.is_alive():
+                # the worker outlived its join budget: mid-prove work is
+                # still running and the summary below is provisional —
+                # never report that as a clean drain
+                timed_out = True
+        # report lines are appended with open/write/close per line, so
+        # the artifact is already on disk; this is the explicit fsync a
+        # deploy's preStop hook wants before the pod goes away
+        if svc.report_path and os.path.exists(svc.report_path):
+            try:
+                fd = os.open(svc.report_path, os.O_RDONLY)
+                try:
+                    os.fsync(fd)
+                finally:
+                    os.close(fd)
+            except OSError:
+                pass
+        summary = svc.summary()
+        self.drained.set()
+        return self._json(
+            200,
+            {
+                "drained": not timed_out,
+                "timed_out": timed_out,
+                "summary": summary,
+                "report_path": svc.report_path,
+            },
+        )
+
+    def _admin_reload(self):
+        """Hot AOT-bundle reload: clear the warmer's dedup set (next
+        batch per bucket re-consults BOOJUM_TPU_AOT_DIR) and drop jax's
+        persistent-cache singleton so a swapped cache dir is re-read —
+        queued work is untouched."""
+        cleared = self.service.warmer.reset()
+        aot_root = None
+        try:
+            from ..prover import aot as _aot
+
+            aot_root = _aot.aot_dir()
+            _aot._reset_persistent_cache()
+        except Exception as e:  # noqa: BLE001 — reload is best-effort;
+            # the warmer reset alone already forces a fresh consult
+            _log(f"gateway: persistent-cache reset failed: {e!r}")
+        self._count("service.gateway.reloads")
+        return self._json(
+            200,
+            {
+                "reloaded": True,
+                "warm_keys_cleared": cleared,
+                "aot_dir": aot_root,
+            },
+        )

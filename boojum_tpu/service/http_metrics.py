@@ -100,31 +100,19 @@ class MetricsPlane:
             def do_GET(self):  # noqa: N802 — http.server API
                 try:
                     path = self.path.split("?", 1)[0].rstrip("/") or "/"
-                    if path == "/metrics":
-                        body = plane.render_metrics().encode()
-                        self._send(
-                            200, body,
-                            "text/plain; version=0.0.4; charset=utf-8",
-                        )
-                    elif path == "/healthz":
-                        self._send(
-                            200,
-                            json.dumps(plane.render_health()).encode(),
-                            "application/json",
-                        )
-                    elif path == "/slo":
-                        self._send(
-                            200,
-                            json.dumps(plane.render_slo()).encode(),
-                            "application/json",
-                        )
-                    else:
+                    out = plane.handle_get(path)
+                    if out is None:
                         self._send(404, b'{"error":"not found"}',
                                    "application/json")
-                except BrokenPipeError:
-                    pass
+                    else:
+                        self._send(*out)
+                except (BrokenPipeError, ConnectionError):
+                    pass  # client went away: not a server error
                 except Exception as e:  # noqa: BLE001 — a probe must
-                    # never crash the serving process
+                    # never crash the serving process; the failure is
+                    # counted (service.http.errors) and answered with a
+                    # 500 body instead of a dropped connection
+                    plane.count_error()
                     try:
                         self._send(
                             500,
@@ -160,6 +148,39 @@ class MetricsPlane:
 
     def url(self, path: str = "") -> str:
         return f"http://{self.host}:{self.port}{path}"
+
+    # ---- routing (shared with the gateway's composed server) -------------
+    PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+    def handle_get(self, path: str):
+        """Route one read-plane GET: (code, body_bytes, content_type) or
+        None for an unknown path. The gateway (service/gateway.py)
+        composes its write plane with this read plane by falling back
+        here, so /metrics, /healthz and /slo are identical whether the
+        plane runs standalone or under the gateway's server."""
+        if path == "/metrics":
+            return 200, self.render_metrics().encode(), self.PROM_CTYPE
+        if path == "/healthz":
+            return (
+                200, json.dumps(self.render_health()).encode(),
+                "application/json",
+            )
+        if path == "/slo":
+            return (
+                200, json.dumps(self.render_slo()).encode(),
+                "application/json",
+            )
+        return None
+
+    def count_error(self):
+        """Charge one handler failure to the `service.http.errors`
+        counter on the sampler's registry (rides /metrics as
+        boojum_tpu_service_http_errors) — a 500 the operator can see
+        beats a silently dropped connection."""
+        try:
+            self.sampler.registry.count("service.http.errors")
+        except Exception:  # noqa: BLE001 — error accounting must never
+            pass           # itself become the error
 
     # ---- endpoint bodies (pure, unit-testable without sockets) -----------
     def render_metrics(self) -> str:

@@ -1,4 +1,4 @@
-"""Shape-bucketed admission queue with priority lanes and backpressure.
+"""Shape-bucketed admission queue: priority lanes, weighted-fair tenants.
 
 Requests are grouped by the SAME shape-bucket key the precompile pass
 and the compile ledger use (`prover/shape_key.py`) — same key means same
@@ -6,15 +6,26 @@ kernel library, shared domain/twiddle caches and a setup that can stay
 device-resident across the batch. The scheduler reads bucket occupancy
 to pick a placement (one big shard-parallel proof vs. packing
 proof-parallel ones), so the queue's job is to keep same-shape work
-adjacent without letting heavy lanes starve interactive ones.
+adjacent without letting heavy lanes — or heavy TENANTS — starve the
+rest.
 
 Lanes are strict-priority: "interactive" drains before "batch" drains
 before "bulk" (a recursive 2^20 aggregation job belongs in bulk; a
-wallet-facing proof in interactive). Within a lane, order is FIFO —
-except that `pop_batch` gathers FOLLOWERS of the head's shape bucket
-from the SAME lane, so a drain amortizes warmed state across every
-queued same-shape request without reordering across buckets more than
-one batch deep.
+wallet-facing proof in interactive). WITHIN a lane, tenants are served
+by **deficit round robin** (ISSUE 11): each tenant keeps a per-lane
+deficit counter topped up by its configured weight as the round-robin
+ring rotates past it, and a tenant is served only while its deficit
+covers the work (one request = one unit). A tenant that drains a large
+same-bucket batch borrows against its deficit (the counter goes
+negative) and is skipped for proportionally many rounds after — the
+debt survives even an emptied backlog while the lane stays contended
+(only CREDIT dies with the backlog; all fairness state clears when the
+whole lane goes idle) — so long-run service inside a lane converges to
+the weight ratios no matter how bursty any one tenant is, while
+same-shape batching (the warmed-state amortizer) is preserved. Per-tenant order is FIFO across buckets
+and within a bucket; `pop_batch` gathers FOLLOWERS of the head's shape
+bucket from the SAME (lane, tenant), so a drain amortizes warmed state
+without reordering more than one batch deep.
 
 Admission is bounded: above `capacity` the queue REJECTS
 (`QueueFullError`) instead of buffering unboundedly — the caller sheds
@@ -35,6 +46,8 @@ from ..utils import metrics as _metrics
 # strict-priority lane order (drain left to right)
 LANES = ("interactive", "batch", "bulk")
 
+DEFAULT_WEIGHT = 1.0
+
 
 class QueueFullError(RuntimeError):
     """Admission rejected: the bounded queue is at capacity (the
@@ -42,32 +55,61 @@ class QueueFullError(RuntimeError):
 
 
 class AdmissionQueue:
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64, weights: dict | None = None):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self._lock = threading.Lock()
         self._not_empty = threading.Condition(self._lock)
-        # lane -> OrderedDict[bucket_key -> list[request]] preserves both
-        # FIFO order across buckets (insertion order of the OrderedDict)
-        # and within a bucket (list append order)
+        # lane -> OrderedDict[tenant -> OrderedDict[bucket_key -> list]].
+        # The tenant OrderedDict IS the DRR ring: its key order is the
+        # round-robin rotation, its head the tenant currently in
+        # service. Bucket order preserves FIFO across buckets (insertion
+        # order) and within a bucket (list append order), per tenant.
         self._lanes: dict[str, OrderedDict] = {
             lane: OrderedDict() for lane in LANES
         }
+        # (lane, tenant) -> DRR deficit (may go negative: borrowing)
+        self._deficit: dict[tuple[str, str], float] = {}
+        self.weights: dict[str, float] = {}
+        for tenant, w in (weights or {}).items():
+            self.set_weight(tenant, w)
         self._depth = 0
         self.rejects = 0
         self.admitted = 0
+        # tenant -> served request count (fairness introspection)
+        self.served: dict[str, int] = {}
+
+    # ---- fairness configuration -----------------------------------------
+    def set_weight(self, tenant: str, weight: float) -> None:
+        """Configure a tenant's DRR weight (its per-rotation quantum,
+        in requests). Unconfigured tenants weigh DEFAULT_WEIGHT."""
+        if not (float(weight) > 0):
+            raise ValueError(
+                f"tenant {tenant!r}: weight must be > 0, got {weight}"
+            )
+        with self._lock:
+            self.weights[tenant] = float(weight)
+
+    def _quantum(self, tenant: str) -> float:
+        return self.weights.get(tenant, DEFAULT_WEIGHT)
+
+    @staticmethod
+    def _tenant_of(request) -> str:
+        return getattr(request, "tenant", None) or "default"
 
     # ---- admission -------------------------------------------------------
     def submit(self, request) -> None:
         """Admit one request (request.priority names the lane,
-        request.bucket_key the shape bucket). Raises QueueFullError at
+        request.bucket_key the shape bucket, request.tenant the DRR
+        class — absent/empty means "default"). Raises QueueFullError at
         capacity."""
         lane = request.priority
         if lane not in self._lanes:
             raise ValueError(
                 f"unknown priority lane {lane!r}: use one of {LANES}"
             )
+        tenant = self._tenant_of(request)
         with self._lock:
             if self._depth >= self.capacity:
                 self.rejects += 1
@@ -77,7 +119,12 @@ class AdmissionQueue:
                     f"{self.rejects} rejects so far"
                 )
             request.admit_ts = time.perf_counter()
-            buckets = self._lanes[lane]
+            tenants = self._lanes[lane]
+            if tenant not in tenants:
+                # a newly-active tenant joins at the ring's TAIL with
+                # zero deficit: no join-with-burst advantage
+                tenants[tenant] = OrderedDict()
+            buckets = tenants[tenant]
             if request.bucket_key not in buckets:
                 buckets[request.bucket_key] = []
             buckets[request.bucket_key].append(request)
@@ -87,22 +134,71 @@ class AdmissionQueue:
             self._not_empty.notify()
 
     # ---- draining --------------------------------------------------------
+    def _drr_pick(self, lane: str, tenants: OrderedDict) -> str:
+        """The deficit-round-robin decision for one lane: rotate the
+        tenant ring, topping each visited tenant's deficit up by its
+        weight, until the head tenant can afford one request. Caller
+        holds the lock. Terminates because every quantum is > 0 (a lone
+        tenant still pays off any borrowed deficit here, a few rotations
+        of its one-element ring, so joining competitors never face an
+        incumbent with banked credit or unbounded debt)."""
+        while True:
+            tenant = next(iter(tenants))
+            key = (lane, tenant)
+            if self._deficit.get(key, 0.0) >= 1.0:
+                return tenant
+            self._deficit[key] = (
+                self._deficit.get(key, 0.0) + self._quantum(tenant)
+            )
+            tenants.move_to_end(tenant)
+
     def pop_batch(self, limit: int | None = None) -> list:
-        """Remove and return the head request plus up to `limit - 1`
-        same-bucket followers from the head's lane (highest-priority
-        nonempty lane first). Empty list when the queue is empty."""
+        """Remove and return the DRR-chosen tenant's head request plus
+        up to `limit - 1` same-bucket followers from the same (lane,
+        tenant) — highest-priority nonempty lane first. The whole batch
+        is charged against the tenant's deficit (which may go negative:
+        a big batch is borrowed against future rounds). Empty list when
+        the queue is empty."""
         with self._lock:
             for lane in LANES:
-                buckets = self._lanes[lane]
-                if not buckets:
+                tenants = self._lanes[lane]
+                if not tenants:
                     continue
+                tenant = self._drr_pick(lane, tenants)
+                buckets = tenants[tenant]
                 key, reqs = next(iter(buckets.items()))
                 take = len(reqs) if limit is None else min(limit, len(reqs))
                 batch = reqs[:take]
                 del reqs[:take]
                 if not reqs:
                     del buckets[key]
+                dkey = (lane, tenant)
+                self._deficit[dkey] = (
+                    self._deficit.get(dkey, 0.0) - len(batch)
+                )
+                if not buckets:
+                    del tenants[tenant]
+                    # an idle tenant must not bank CREDIT while away —
+                    # but borrowed DEBT survives the empty backlog, or a
+                    # bursty tenant could drain a big batch, go briefly
+                    # idle, and rejoin at zero to lap its siblings
+                    # (resubmit-after-drain would evade the weight
+                    # ratios entirely)
+                    if self._deficit[dkey] >= 0.0:
+                        del self._deficit[dkey]
+                    if not tenants:
+                        # the LANE going idle ends the contention the
+                        # deficits arbitrate: clear its fairness state
+                        # so a tenant returning much later isn't starved
+                        # over debts nobody was waiting behind
+                        for k in [
+                            k for k in self._deficit if k[0] == lane
+                        ]:
+                            del self._deficit[k]
                 self._depth -= len(batch)
+                self.served[tenant] = (
+                    self.served.get(tenant, 0) + len(batch)
+                )
                 _metrics.gauge_service("queue.depth", self._depth)
                 return batch
             return []
@@ -124,20 +220,23 @@ class AdmissionQueue:
 
     def occupancy(self, bucket_key: str) -> int:
         """How many queued requests share this shape bucket (across all
-        lanes) — the scheduler's proof-parallel packing signal."""
+        lanes and tenants) — the scheduler's proof-parallel packing
+        signal."""
         with self._lock:
             return sum(
                 len(buckets.get(bucket_key, ()))
-                for buckets in self._lanes.values()
+                for tenants in self._lanes.values()
+                for buckets in tenants.values()
             )
 
     def bucket_depths(self) -> dict[str, int]:
-        """bucket_key -> queued request count, across lanes."""
+        """bucket_key -> queued request count, across lanes/tenants."""
         with self._lock:
             out: dict[str, int] = {}
-            for buckets in self._lanes.values():
-                for key, reqs in buckets.items():
-                    out[key] = out.get(key, 0) + len(reqs)
+            for tenants in self._lanes.values():
+                for buckets in tenants.values():
+                    for key, reqs in buckets.items():
+                        out[key] = out.get(key, 0) + len(reqs)
             return out
 
     def lane_depths(self) -> dict[str, int]:
@@ -147,6 +246,23 @@ class AdmissionQueue:
         fire."""
         with self._lock:
             return {
-                lane: sum(len(reqs) for reqs in buckets.values())
-                for lane, buckets in self._lanes.items()
+                lane: sum(
+                    len(reqs)
+                    for buckets in tenants.values()
+                    for reqs in buckets.values()
+                )
+                for lane, tenants in self._lanes.items()
             }
+
+    def tenant_depths(self) -> dict[str, int]:
+        """tenant -> queued request count across lanes — the fairness
+        axis of the telemetry plane (gateway dashboards watch a heavy
+        tenant's backlog grow while its siblings stay drained)."""
+        with self._lock:
+            out: dict[str, int] = {}
+            for tenants in self._lanes.values():
+                for tenant, buckets in tenants.items():
+                    out[tenant] = out.get(tenant, 0) + sum(
+                        len(reqs) for reqs in buckets.values()
+                    )
+            return out

@@ -153,6 +153,16 @@ class VariantWarmer:
         self.max_workers = max_workers
         self._warmed: set[tuple] = set()
 
+    def reset(self) -> int:
+        """Forget every (bucket, placement) warm — the hot AOT-bundle
+        reload verb (`POST /admin/reload-artifacts`): the next batch of
+        each bucket re-runs the artifact-store consult + warm against
+        whatever is in BOOJUM_TPU_AOT_DIR NOW, without dropping queued
+        work. Returns how many warm keys were forgotten."""
+        n = len(self._warmed)
+        self._warmed.clear()
+        return n
+
     def warm(self, bucket, assembly, config, placement: Placement) -> bool:
         if self.mode == "off":
             return False

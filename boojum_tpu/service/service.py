@@ -132,6 +132,9 @@ class ProveRequest:
     tenant: str = "default"
     capture_trace: bool = False    # record a jax.profiler trace of the
     #                                prove (report line carries the dir)
+    gateway: bool = False          # admitted over HTTP (service/gateway.py):
+    #                                the report line must carry a tenant
+    #                                record (--check enforces it)
     bucket: object = None          # ShapeBucket, stamped at submit
     bucket_key: str = ""
     submit_ts: float = 0.0
@@ -209,6 +212,13 @@ class ProvingService:
             "service.cache.pinned_bytes",
             lambda: self.cache.stats().get("pinned_bytes", 0),
         )
+        self.sampler.add_provider(
+            "service.queue.tenant", self.queue.tenant_depths
+        )
+        # per-tenant byte/compute quota accounting (tenant.QuotaLedger);
+        # installed by the gateway — None keeps in-process submit()
+        # admission unmetered, exactly as before ISSUE 11
+        self.quota = None
         self.metrics_plane = None
         self._owns_sampler_install = False
         # packed proof-parallel mode mutates these from pool threads
@@ -232,6 +242,7 @@ class ProvingService:
         tenant: str = "default",
         request_id: str | None = None,
         capture_trace: bool = False,
+        gateway: bool = False,
     ) -> ProveRequest:
         """Admit one job (raises QueueFullError at the queue bound —
         the caller's backpressure signal). Shape bucketing happens here,
@@ -247,6 +258,7 @@ class ProvingService:
             priority=priority,
             tenant=tenant,
             capture_trace=capture_trace,
+            gateway=gateway,
         )
         req.bucket = shape_bucket(assembly, config)
         req.bucket_key = req.bucket.key
@@ -313,17 +325,26 @@ class ProvingService:
                 self.metrics_plane = None
 
     # ---- telemetry plane -------------------------------------------------
-    def start_telemetry(self, metrics_port: int | None = None) -> int | None:
+    def start_telemetry(
+        self,
+        metrics_port: int | None = None,
+        sampler_only: bool = False,
+    ) -> int | None:
         """Start the background sampler (installed process-wide so
         report lines pick up the `telemetry` record) and, with a port
         (0 = any free port; None falls back to the config's
-        metrics_port), the HTTP metrics plane. Returns the bound port
-        or None. Idempotent; a bind failure logs and degrades to
-        sampler-only — observability must never take the prover down."""
+        metrics_port), the HTTP metrics plane. `sampler_only=True`
+        never binds a standalone plane regardless of the config port —
+        the gateway posture, where /metrics rides the composed server.
+        Returns the bound port or None. Idempotent; a bind failure logs
+        and degrades to sampler-only — observability must never take
+        the prover down."""
         from ..utils import telemetry as _telemetry
 
         if metrics_port is None:
             metrics_port = self.config.metrics_port
+        if sampler_only:
+            metrics_port = None
         if not self.sampler.running():
             # only adopt the process-wide slot if nobody else (a bench
             # harness, another service) owns it
@@ -467,8 +488,12 @@ class ProvingService:
         fires under packing.)"""
         path = self.report_path
         if not path:
-            return self._run_request(req, placement, packed=packed,
-                                     device=device)
+            ok = self._run_request(req, placement, packed=packed,
+                                   device=device)
+            # quota is settled even without a report artifact — a
+            # metered tenant's window must fill either way
+            self._charge_quota(req)
+            return ok
         with _report.flight_recording(
             label=f"service:{req.id}", scoped=True
         ) as rec:
@@ -480,9 +505,11 @@ class ProvingService:
                 # when the prove raised — a failed request's partial
                 # spans + SLO fields are the post-mortem
                 try:
-                    line = _report.build_report(
-                        rec, extra={"request": dict(req.slo)}
-                    )
+                    extra = {"request": dict(req.slo)}
+                    tenant_rec = self._charge_quota(req, rec)
+                    if tenant_rec is not None:
+                        extra["tenant"] = tenant_rec
+                    line = _report.build_report(rec, extra=extra)
                     # the request line must carry THIS service's time
                     # series (queue depth, lane occupancy, in-flight) —
                     # build_report read the process-global sampler slot,
@@ -503,6 +530,32 @@ class ProvingService:
                     # never turn a served proof into a failure
                     _log(f"service: report write failed: {e!r}")
         return ok
+
+    def _charge_quota(self, req: ProveRequest, rec=None) -> dict | None:
+        """Settle one request's per-tenant quota bill (tenant.QuotaLedger,
+        installed by the gateway) from the numbers the flight recorder
+        already collected: explicit host<->device transfer bytes plus the
+        serialized proof size on the byte axis, prove wall on the compute
+        axis. Returns the per-line `tenant` record, or None when the
+        service is unmetered. Charging must never fail a served proof."""
+        if self.quota is None:
+            return None
+        try:
+            nbytes = 0
+            if rec is not None:
+                counters = rec.metrics.to_dict().get("counters") or {}
+                nbytes += int(counters.get("transfer.h2d_bytes", 0))
+                nbytes += int(counters.get("transfer.d2h_bytes", 0))
+            if req.proof is not None:
+                try:
+                    nbytes += len(req.proof.to_json())
+                except Exception:  # noqa: BLE001
+                    pass
+            compute_s = req.slo.get("prove_wall_s") or 0.0
+            return self.quota.charge(req.tenant, nbytes, compute_s)
+        except Exception as e:  # noqa: BLE001
+            _log(f"service: quota charge failed for {req.id}: {e!r}")
+            return None
 
     def _serve_packed(self, batch: list, placement: Placement) -> int:
         """Proof-parallel packing: same-bucket requests run concurrently,
@@ -553,6 +606,10 @@ class ProvingService:
             "queue_latency_s": round(queue_latency, 6),
             "cache_hit": hit,
         }
+        if req.gateway:
+            # gateway-admitted: --check requires the line to carry a
+            # tenant record alongside this flag
+            req.slo["gateway"] = True
         if device is not None:
             import jax
 

@@ -1,0 +1,204 @@
+"""Tenant model for the network admission plane (gateway.py).
+
+Three concerns, deliberately tiny and stdlib-only:
+
+- **TenantSpec**: one tenant's identity and entitlements — the shared
+  secret `token` the gateway maps to a tenant id at the front door, the
+  DRR `weight` the admission queue schedules the tenant at inside its
+  lane, and the per-window byte/compute quotas the ledger enforces.
+- **parse_tenant_specs**: the operator surface. Accepts either a JSON
+  list (inline or `@file.json`) or the compact
+  `id:token[:weight[:quota_bytes[:quota_compute_s]]]` comma form that
+  fits in one env var (`BOOJUM_TPU_GATEWAY_TENANTS`).
+- **QuotaLedger**: fixed-window byte + compute accounting, charged from
+  the per-request flight-recorder records the service already produces
+  (transfer counters + prove wall + proof bytes — PR 8 made these free).
+  Exhaustion is a **429 + Retry-After** decision at admission, never a
+  mid-prove kill: `admit()` answers before work is accepted, `charge()`
+  settles after the prove so the NEXT window boundary is when an
+  exhausted tenant gets service again. The ledger also feeds the
+  `service.tenant.*` telemetry axis (snapshot() is registered as a
+  sampler provider by the gateway, so per-tenant usage rides /metrics
+  and every report line's `telemetry` record).
+
+Quotas are per fixed window (default 60 s) rather than token-bucket:
+a prover's unit of work is seconds long, so sub-window smoothing buys
+nothing, and the fixed window gives an exact, explainable Retry-After.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TenantSpec:
+    """One tenant's identity + entitlements (see module docstring)."""
+
+    id: str
+    token: str
+    weight: float = 1.0          # DRR quantum inside each lane (queue.py)
+    quota_bytes: int | None = None       # per-window byte budget (None = ∞)
+    quota_compute_s: float | None = None  # per-window prove-wall budget
+    admin: bool = False          # may call the /admin/* verbs
+
+    def __post_init__(self):
+        if not self.id or not self.token:
+            raise ValueError("tenant needs a non-empty id and token")
+        if not (self.weight > 0):
+            raise ValueError(
+                f"tenant {self.id!r}: weight must be > 0, got {self.weight}"
+            )
+
+
+def parse_tenant_specs(text: str) -> list[TenantSpec]:
+    """Parse the operator's tenant table (BOOJUM_TPU_GATEWAY_TENANTS).
+
+    Forms:
+      '@/path/tenants.json'      — JSON list loaded from a file
+      '[{"id": ..., "token": ...}, ...]' — inline JSON list
+      'id:token[:weight[:quota_bytes[:quota_compute_s]]],id2:tok2'
+                                 — compact env-var form; an 'admin' flag
+                                   rides as a trailing ':admin'
+    """
+    text = (text or "").strip()
+    if not text:
+        return []
+    if text.startswith("@"):
+        with open(text[1:]) as f:
+            text = f.read().strip()
+    if text.startswith("["):
+        out = []
+        for entry in json.loads(text):
+            out.append(TenantSpec(
+                id=entry["id"],
+                token=entry["token"],
+                weight=float(entry.get("weight", 1.0)),
+                quota_bytes=(
+                    None if entry.get("quota_bytes") is None
+                    else int(entry["quota_bytes"])
+                ),
+                quota_compute_s=(
+                    None if entry.get("quota_compute_s") is None
+                    else float(entry["quota_compute_s"])
+                ),
+                admin=bool(entry.get("admin", False)),
+            ))
+        return out
+    out = []
+    for item in text.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        parts = item.split(":")
+        if len(parts) < 2:
+            raise ValueError(
+                f"tenant entry {item!r}: want id:token[:weight[...]]"
+            )
+        admin = False
+        # the trailing flag is only a flag PAST the mandatory id:token
+        # prefix — a tenant whose shared secret is literally "admin"
+        # ('ops:admin') keeps its token
+        if len(parts) > 2 and parts[-1].strip().lower() == "admin":
+            admin = True
+            parts = parts[:-1]
+        tid, token = parts[0], parts[1]
+        weight = float(parts[2]) if len(parts) > 2 and parts[2] else 1.0
+        qb = int(parts[3]) if len(parts) > 3 and parts[3] else None
+        qc = float(parts[4]) if len(parts) > 4 and parts[4] else None
+        out.append(TenantSpec(
+            id=tid, token=token, weight=weight,
+            quota_bytes=qb, quota_compute_s=qc, admin=admin,
+        ))
+    return out
+
+
+class QuotaLedger:
+    """Fixed-window per-tenant byte + compute accounting.
+
+    `admit()` is the 429 decision at the front door; `charge()` settles
+    a served request's bill from its flight-recorder numbers. Unknown
+    tenants (no spec) are unlimited but still metered, so the telemetry
+    axis covers them too. All methods take an optional `now` (monotonic
+    seconds) so window math is unit-testable without sleeping."""
+
+    def __init__(self, specs=(), window_s: float = 60.0):
+        if not (window_s > 0):
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = float(window_s)
+        self._specs: dict[str, TenantSpec] = {s.id: s for s in specs}
+        self._lock = threading.Lock()
+        # tenant -> {"start": window_start, "bytes": int, "compute_s": f}
+        self._usage: dict[str, dict] = {}
+        self.throttled: dict[str, int] = {}
+
+    def spec(self, tenant_id: str) -> TenantSpec | None:
+        return self._specs.get(tenant_id)
+
+    def _window(self, tenant_id: str, now: float) -> dict:
+        # caller holds self._lock
+        u = self._usage.get(tenant_id)
+        if u is None or now - u["start"] >= self.window_s:
+            u = {"start": now, "bytes": 0, "compute_s": 0.0}
+            self._usage[tenant_id] = u
+        return u
+
+    def admit(self, tenant_id: str, now: float | None = None):
+        """(ok, retry_after_s): may this tenant enqueue more work NOW?
+        Exhausted -> (False, seconds until the window resets) and the
+        rejection is tallied on `throttled` (the 429 count)."""
+        now = time.monotonic() if now is None else now
+        spec = self._specs.get(tenant_id)
+        with self._lock:
+            u = self._window(tenant_id, now)
+            over = spec is not None and (
+                (spec.quota_bytes is not None
+                 and u["bytes"] >= spec.quota_bytes)
+                or (spec.quota_compute_s is not None
+                    and u["compute_s"] >= spec.quota_compute_s)
+            )
+            if not over:
+                return True, 0.0
+            self.throttled[tenant_id] = self.throttled.get(tenant_id, 0) + 1
+            return False, max(0.0, u["start"] + self.window_s - now)
+
+    def charge(
+        self,
+        tenant_id: str,
+        nbytes: int,
+        compute_s: float,
+        now: float | None = None,
+    ) -> dict:
+        """Settle one served request's bill; returns the per-line
+        `tenant` record (prove_report.py --check validates it)."""
+        now = time.monotonic() if now is None else now
+        nbytes = max(0, int(nbytes))
+        compute_s = max(0.0, float(compute_s))
+        with self._lock:
+            u = self._window(tenant_id, now)
+            u["bytes"] += nbytes
+            u["compute_s"] += compute_s
+            return {
+                "id": tenant_id,
+                "charged_bytes": nbytes,
+                "charged_compute_s": round(compute_s, 6),
+                "window_used_bytes": u["bytes"],
+                "window_used_compute_s": round(u["compute_s"], 6),
+            }
+
+    def snapshot(self) -> dict:
+        """Flat {<tenant>.<axis>: value} dict — registered as a sampler
+        provider ('service.tenant') so per-tenant usage rides /metrics
+        (`telemetry.service.tenant.*` gauges) and the report lines'
+        `telemetry` records."""
+        with self._lock:
+            out: dict[str, float] = {}
+            for tid, u in self._usage.items():
+                out[f"{tid}.used_bytes"] = float(u["bytes"])
+                out[f"{tid}.used_compute_s"] = round(u["compute_s"], 6)
+            for tid, n in self.throttled.items():
+                out[f"{tid}.throttled"] = float(n)
+            return out

@@ -571,6 +571,50 @@ def validate_report(report: dict) -> list[str]:
                 )
             if request.get("id") is not None:
                 span_request_ids.add(str(request["id"]))
+    # per-tenant record (gateway lines, ISSUE 11): quota charges must be
+    # sane non-negative numbers, a gateway-ADMITTED request line must
+    # carry the record at all (the quota axis is the whole point of
+    # admitting through the front door), and a REJECTED line (429 /
+    # load-shed) must never claim a prove wall — nothing was proved.
+    tenant = report.get("tenant")
+    if tenant is not None:
+        if not isinstance(tenant, dict):
+            problems.append(
+                f"tenant record malformed: {type(tenant).__name__}"
+            )
+            tenant = None
+        else:
+            tid = tenant.get("id")
+            if not isinstance(tid, str) or not tid:
+                problems.append(f"tenant record id invalid: {tid!r}")
+            for k in (
+                "charged_bytes", "charged_compute_s",
+                "window_used_bytes", "window_used_compute_s",
+                "retry_after_s",
+            ):
+                if k not in tenant:
+                    continue
+                v = tenant.get(k)
+                if not isinstance(v, (int, float)) or v != v or v < 0:
+                    problems.append(f"tenant {k} invalid: {v!r}")
+            if tenant.get("rejected"):
+                pw = (
+                    request.get("prove_wall_s")
+                    if isinstance(request, dict) else None
+                )
+                if isinstance(pw, (int, float)):
+                    problems.append(
+                        "rejected admission carries prove_wall_s "
+                        f"({pw!r}): a 429/shed line must never prove"
+                    )
+    if (
+        isinstance(request, dict)
+        and request.get("gateway")
+        and tenant is None
+    ):
+        problems.append(
+            "gateway-admitted request line missing its tenant record"
+        )
     if len(span_request_ids) > 1:
         problems.append(
             "line mixes request ids "
@@ -778,6 +822,48 @@ def slo_summary(reports: list[dict]) -> dict:
     def r6(v):
         return None if v is None else round(v, 6)
 
+    # per-tenant axis (ISSUE 11): latency/wall percentiles per tenant id
+    # over the request records, plus the gateway's rejected admissions
+    # (tenant records with `rejected` set: 429 quota throttles and
+    # load-sheds) — the fairness/quota numbers a multi-tenant deploy
+    # watches
+    tenants: dict[str, dict] = {}
+
+    def _tslot(tid: str) -> dict:
+        return tenants.setdefault(
+            tid, {"requests": 0, "lat": [], "walls": [], "rejected": 0}
+        )
+
+    for q in reqs:
+        slot = _tslot(str(q.get("tenant", "default")))
+        slot["requests"] += 1
+        if isinstance(q.get("queue_latency_s"), (int, float)):
+            slot["lat"].append(q["queue_latency_s"])
+        if "error" not in q and isinstance(
+            q.get("prove_wall_s"), (int, float)
+        ):
+            slot["walls"].append(q["prove_wall_s"])
+    shed = {"throttled": 0, "shed": 0}
+    for r in reports:
+        t = r.get("tenant")
+        if not isinstance(t, dict) or not t.get("rejected"):
+            continue
+        _tslot(str(t.get("id", "default")))["rejected"] += 1
+        reason = t.get("reason")
+        if reason not in shed:
+            # legacy/foreign lines without a reason: classify by code
+            reason = "throttled" if t.get("rejected") == 429 else "shed"
+        shed[reason] += 1
+    tenant_summary = {
+        tid: {
+            "requests": s["requests"],
+            "rejected": s["rejected"],
+            "queue_latency_p95_s": r6(_percentile(sorted(s["lat"]), 0.95)),
+            "prove_wall_p95_s": r6(_percentile(sorted(s["walls"]), 0.95)),
+        }
+        for tid, s in sorted(tenants.items())
+    }
+
     # artifact-hit rate over the artifact's lines: every aot.hits /
     # aot.misses counter recorded anywhere in the stream (service warm
     # phases, bench warm-ups) — the deployment-health axis the AOT
@@ -818,6 +904,8 @@ def slo_summary(reports: list[dict]) -> dict:
         "cache_hit_rate": (
             round(cache_hits / len(reqs), 4) if reqs else None
         ),
+        "tenants": tenant_summary,
+        "rejected": shed,
         "aot_kernels_warmed": aot_hits + aot_misses,
         "aot_hit_rate": (
             round(aot_hits / (aot_hits + aot_misses), 4)
@@ -861,6 +949,19 @@ def render_slo(summary: dict) -> str:
             + ", ".join(
                 f"{k}={v}" for k, v in summary["priorities"].items()
             )
+        )
+    rejected = summary.get("rejected") or {}
+    if any(rejected.values()):
+        lines.append(
+            f"  rejected      throttled(429)={rejected.get('throttled', 0)} "
+            f"shed={rejected.get('shed', 0)}"
+        )
+    for tid, t in (summary.get("tenants") or {}).items():
+        lines.append(
+            f"  tenant {tid:<12} {t['requests']} requests, "
+            f"queue p95={t['queue_latency_p95_s']}s "
+            f"wall p95={t['prove_wall_p95_s']}s, "
+            f"rejected={t['rejected']}"
         )
     return "\n".join(lines)
 

@@ -26,6 +26,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: excluded from the tier-1 budget (-m 'not slow')"
     )
+    # gateway tests bind loopback sockets (ISSUE 11); they stay in
+    # tier-1 by default, but sandboxed runners without socket permits
+    # can exclude them wholesale with -m 'not gateway'
+    config.addinivalue_line(
+        "markers",
+        "gateway: binds loopback HTTP sockets (-m 'not gateway' to skip "
+        "on sandboxed runners)",
+    )
 
 
 def pytest_collection_modifyitems(items):

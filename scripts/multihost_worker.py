@@ -11,6 +11,13 @@ process), runs the requested DCN mode, and writes its result JSON. Modes:
             shape-bucketed admission, device-resident caches, per-request
             SLO records; no cross-process collectives. The per-host
             result-line format (proofs dict, ici gauges) is unchanged.
+            With BOOJUM_TPU_GATEWAY_SPOOL set (ISSUE 11), the process
+            ALSO takes its distribute_proofs slice of the gateway's
+            spool directory — one JSON job file per request, written by
+            service/gateway.py for bulk-lane admissions — so the
+            horizontal tier has a feed path from the network front door.
+            Spool specs carry {"job", "tenant", "seed", "priority"};
+            each proved job lands in the result line's "spool" dict.
   hybrid  — hybrid_mesh: one proof whose mesh 'col' axis spans both
             processes (GSPMD collectives cross the process boundary)
 """
@@ -120,12 +127,45 @@ def main():
             return svc.submit(asm, setup, cfg, request_id=f"job-{seed}")
 
         mine = distribute_proofs(jobs, submit_job)
+
+        # gateway spool feed (ISSUE 11): this host's slice of the front
+        # door's bulk-lane spool rides the same service drain
+        spool_dir = os.environ.get("BOOJUM_TPU_GATEWAY_SPOOL")
+        mine_spool = []
+        if spool_dir and os.path.isdir(spool_dir):
+            from boojum_tpu.service.gateway import read_spool
+
+            def submit_spool(item):
+                _fname, spec = item
+                asm = build_circuit(int(spec.get("seed", 0))).into_assembly()
+                setup = generate_setup(asm, cfg)
+                priority = spec.get("priority", "bulk")
+                return svc.submit(
+                    asm, setup, cfg,
+                    request_id=str(spec.get("job", _fname)),
+                    tenant=str(spec.get("tenant", "default")),
+                    priority=priority if priority in (
+                        "interactive", "batch", "bulk"
+                    ) else "bulk",
+                )
+
+            mine_spool = distribute_proofs(read_spool(spool_dir),
+                                           submit_spool)
+
         summary = svc.run_worker()
         result["service"] = summary
         assert summary["failed"] == 0, summary
         for _i, req in mine:
             assert verify(req.setup.vk, req.result(), req.assembly.gates)
         result["proofs"] = {str(i): req.result().to_json() for i, req in mine}
+        if mine_spool:
+            for _i, req in mine_spool:
+                assert verify(
+                    req.setup.vk, req.result(), req.assembly.gates
+                )
+            result["spool"] = {
+                req.id: req.result().to_json() for _i, req in mine_spool
+            }
     elif mode == "hybrid":
         mesh = hybrid_mesh(col_axis_per_host=2)
         assert mesh.shape["col"] == nprocs * 2, dict(mesh.shape)

@@ -30,16 +30,23 @@ Usage:
       escaped the artifact store — all fail), the schema-2 `telemetry`
       record (background-sampler time series: malformed cadence,
       negative readings or time-disordered samples fail), and the
-      context-scoping invariant — a line whose spans/request record mix
-      TWO request ids means the packed service's scoped collectors bled
-      across requests, and FAILS. Exits 1 on any problem.
+      per-tenant `tenant` record of gateway lines (ISSUE 11: a
+      gateway-admitted request line MISSING its tenant record fails,
+      quota charges must be finite and non-negative, and a 429/load-shed
+      rejection line must never carry a prove wall — nothing was
+      proved), and the context-scoping invariant — a line whose
+      spans/request record mix TWO request ids means the packed
+      service's scoped collectors bled across requests, and FAILS.
+      Exits 1 on any problem.
 
   python scripts/prove_report.py --slo <report.jsonl>
       Aggregate the per-request SLO records of a proving-service
       artifact: p50/p95 queue latency and prove wall, proofs/sec over
       the serving span, per-placement/priority counts, cache hit rate,
       and the AOT artifact hit rate over every warmed kernel in the
-      stream. An artifact with ZERO request records (plain proves,
+      stream. Gateway artifacts additionally get per-tenant p95s and
+      the rejected-admission counts (429 quota throttles, load-sheds).
+      An artifact with ZERO request records (plain proves,
       bench reps) has no serving span to aggregate — that is reported
       explicitly and exits 0 (nothing to summarize is not a failure).
 

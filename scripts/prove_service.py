@@ -13,6 +13,18 @@ Usage:
          "lde": 2, "queries": 4, "final_degree": 16}
       entries. Same-shape jobs bucket together in the admission queue.
 
+  python scripts/prove_service.py --gateway [--port P] [--report out.jsonl]
+      Serve the NETWORK admission plane (ISSUE 11): POST /prove with
+      tenant bearer tokens + Idempotency-Key replay, GET /jobs/<id>
+      (+ /proof download), POST /admin/drain and /admin/reload-artifacts,
+      with /metrics, /healthz and /slo composed under the same server.
+      Tenants come from BOOJUM_TPU_GATEWAY_TENANTS
+      ("id:token[:weight[:quota_bytes[:quota_compute_s]]][,...]", inline
+      JSON list, or @file.json); with none configured a single demo
+      tenant is synthesized and its token printed to stderr. The process
+      serves until POST /admin/drain completes (or Ctrl-C, which drains).
+      Job specs over the wire are the same JSON objects --jobs takes.
+
 Environment (see README "Environment flags"):
   BOOJUM_TPU_SERVICE_QUEUE_CAP    admission-queue bound (default 64)
   BOOJUM_TPU_SERVICE_CACHE_BYTES  device-cache LRU cap (default 2 GiB)
@@ -104,6 +116,61 @@ def _job_parts(spec: dict):
     return asm, generate_setup(asm, config), config
 
 
+def make_spec_resolver():
+    """spec dict -> (assembly, setup, config), memoized per distinct
+    circuit spec (the gateway's resolver: repeated specs re-submit the
+    same parts — the device-cache hit path, exactly like --jobs)."""
+    parts_cache: dict[str, tuple] = {}
+
+    def resolve(spec: dict):
+        key = json.dumps(
+            {
+                k: v for k, v in spec.items()
+                if k not in ("priority", "count", "capture_trace")
+            },
+            sort_keys=True,
+        )
+        if key not in parts_cache:
+            parts_cache[key] = _job_parts(spec)
+        return parts_cache[key]
+
+    return resolve
+
+
+def run_gateway(svc, args) -> int:
+    """--gateway: serve the admission plane until drained."""
+    import secrets
+
+    from boojum_tpu.service import Gateway, GatewayConfig, TenantSpec
+
+    cfg = GatewayConfig.from_env()
+    if args.port is not None:
+        cfg.port = args.port
+    if not cfg.tenants:
+        token = secrets.token_hex(16)
+        cfg.tenants = [TenantSpec(id="default", token=token, admin=True)]
+        print(f"gateway: no tenants configured — demo tenant 'default' "
+              f"token={token} (admin)", file=sys.stderr)
+    gw = Gateway(svc, cfg, make_spec_resolver())
+    port = gw.start()
+    print(
+        f"gateway: serving http://{cfg.host}:{port} — POST /prove, "
+        f"GET /jobs/<id>[/proof], /metrics /healthz /slo, "
+        f"POST /admin/drain | /admin/reload-artifacts",
+        file=sys.stderr,
+    )
+    try:
+        while not gw.drained.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        print("gateway: interrupt — draining", file=sys.stderr)
+        gw.drain()
+    finally:
+        gw.stop()
+    print(json.dumps(svc.summary()))
+    return 0
+
+
 def demo_jobs(n: int) -> list[dict]:
     """A mixed demo batch: two geometries, alternating lanes, so the
     queue buckets, the scheduler sees occupancy, and the cache manager
@@ -129,6 +196,12 @@ def main(argv=None) -> int:
                     help="enqueue N mixed demo jobs")
     ap.add_argument("--jobs", metavar="JOBS_JSON",
                     help="job spec file (JSON list)")
+    ap.add_argument("--gateway", action="store_true",
+                    help="serve the HTTP admission plane until drained "
+                         "(tenants: BOOJUM_TPU_GATEWAY_TENANTS)")
+    ap.add_argument("--port", type=int, metavar="PORT",
+                    help="--gateway bind port (default: "
+                         "BOOJUM_TPU_GATEWAY_PORT, 0 = any free port)")
     ap.add_argument("--report", metavar="OUT_JSONL",
                     help="per-request SLO report path "
                          "(default: BOOJUM_TPU_REPORT)")
@@ -144,7 +217,7 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", action="store_true",
                     help="verify every proof after the drain")
     args = ap.parse_args(argv)
-    if not args.demo and not args.jobs:
+    if not args.demo and not args.jobs and not args.gateway:
         ap.print_usage()
         return 2
 
@@ -184,6 +257,9 @@ def main(argv=None) -> int:
                 "(see service log)",
                 file=sys.stderr,
             )
+
+    if args.gateway:
+        return run_gateway(svc, args)
 
     specs = demo_jobs(args.demo) if args.demo else json.load(open(args.jobs))
     requests = []
