@@ -498,6 +498,31 @@ def _emit(status):
             out["limb_sweep"] = bool(limb_sweep_enabled())
         except Exception:
             pass
+        # machine/software identity (ISSUE 12): the same block the AOT
+        # manifest validates on, so --trend groups this line with the
+        # right machine's history
+        try:
+            from boojum_tpu.prover.aot import platform_info
+
+            out["host"] = platform_info()
+        except Exception:
+            pass
+        # the roofline cost record of the last completed prove (ISSUE
+        # 12): per-stage achieved GFLOP/s & GB/s vs peak — the "which
+        # kernel left perf on the table" axis BENCH_r05+ lines carry
+        # (the kernel list is the analytic sheet's coverage; it rides
+        # the report artifact, not this line)
+        try:
+            from boojum_tpu.utils import costmodel as _costmodel
+
+            rec_cost = _costmodel.last_cost_record()
+            if rec_cost:
+                out["cost"] = {
+                    k: v for k, v in rec_cost.items()
+                    if k not in ("kernels", "attributed_kernels")
+                }
+        except Exception:
+            pass
         # live-telemetry time series (queue-less in bench, but device
         # memory + live-buffer census over the whole run): the same
         # `telemetry` record the service's report lines carry, so a

@@ -44,8 +44,27 @@ def timed_chain(body, x, reps):
     return (time.perf_counter() - t0) / reps
 
 
+def host_identity() -> dict:
+    """The machine/software identity block stamped on every JSON line
+    (ISSUE 12 satellite): host CPU fingerprint, device kind, backend,
+    jax/jaxlib versions — the SAME fields prover/aot.py validates bundle
+    portability on, so `prove_report.py --trend` can group micro lines
+    by machine and software version instead of mixing a laptop's numbers
+    into a pod's series. platform_info() memoizes per process."""
+    try:
+        from boojum_tpu.prover.aot import platform_info
+
+        return platform_info()
+    except Exception:
+        return {}
+
+
 def emit(metric, value, unit, **extra):
-    print(json.dumps({"metric": metric, "value": value, "unit": unit, **extra}))
+    line = {"metric": metric, "value": value, "unit": unit, **extra}
+    ident = host_identity()
+    if ident:
+        line["host"] = ident
+    print(json.dumps(line))
 
 
 def main():

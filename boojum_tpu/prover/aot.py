@@ -170,9 +170,18 @@ def variant_fingerprint(mesh_shape=None) -> dict:
     }
 
 
+_PLATFORM_INFO: dict | None = None
+
+
 def platform_info() -> dict:
     """The exact-match stack identity the compiled cache entries are
-    valid on (manifest-recorded, load-validated)."""
+    valid on (manifest-recorded, load-validated). Computed once per
+    process — it re-probes jax.devices() and hashes /proc/cpuinfo, and
+    every report/bench line carries it — then copied per call so a
+    caller mutating its manifest can't poison the cache."""
+    global _PLATFORM_INFO
+    if _PLATFORM_INFO is not None:
+        return dict(_PLATFORM_INFO)
     import jax
     import jaxlib
 
@@ -187,7 +196,7 @@ def platform_info() -> dict:
         ndev = int(jax.device_count())
     except Exception:
         ndev = 0
-    return {
+    info = {
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "backend": jax.default_backend(),
@@ -195,6 +204,13 @@ def platform_info() -> dict:
         "num_devices": ndev,
         "host_fp": host_fingerprint(),
     }
+    # memoize SUCCESSFUL probes only: a first call racing device
+    # availability (backend not up yet, pre-distributed-init worker)
+    # must not pin kind='unknown' for the process lifetime — that would
+    # reject every bundle load and mis-identify every report line
+    if kind != "unknown" and ndev > 0:
+        _PLATFORM_INFO = info
+    return dict(info)
 
 
 def bundle_name(bucket_key: str, variant: dict) -> str:
@@ -457,8 +473,17 @@ def build_bundle(
 
             kernels = []
             export_ok = 0
+            # compile-time cost actuals (ISSUE 12): the sweep above
+            # recorded each kernel's cost_analysis()/memory_analysis()
+            # into the ledger — persist them in the manifest so a
+            # zero-compile cold consumer still carries actuals even
+            # when its deserialized executables refuse the analysis
+            ledger_costs = ledger.kernel_costs(shape_key=sb.key)
             for spec in specs:
                 ent: dict = {"name": spec.name}
+                cost = ledger_costs.get(spec.name)
+                if cost:
+                    ent["cost"] = cost
                 if aot_export_enabled():
                     try:
                         from jax import export as _export
@@ -710,6 +735,7 @@ def warm_from_bundle(
     mesh_shape=None,
     ledger: CompileLedger | None = None,
     specs=None,
+    manifest_costs: dict | None = None,
 ) -> dict:
     """Lower + compile the enumerated kernel library SERIALLY, so each
     kernel's persistent-cache hit/miss is attributable: the monitoring
@@ -718,6 +744,12 @@ def warm_from_bundle(
     escaped to the compiler) or not. Serial is the right shape here —
     lowering is GIL-bound Python either way and a warmed compile is a
     local cache read, so there are no slow RPCs left to overlap.
+
+    `manifest_costs` ({kernel_name: xla_cost dict}, from the bundle
+    manifest) backfills cost actuals for kernels whose deserialized
+    executables refuse `cost_analysis()` — a zero-compile cold process
+    still attributes per-kernel flops/bytes without recompiling
+    anything (ISSUE 12).
 
     Returns {"kernels", "aot_hits", "aot_misses", "deserialize_s"}."""
     import jax
@@ -766,7 +798,7 @@ def warm_from_bundle(
                 m0 = _CACHE_EVENTS["misses"]
                 t1 = time.perf_counter()
                 try:
-                    low.compile()
+                    compiled = low.compile()
                 except Exception as e:  # noqa: BLE001
                     ledger.record(
                         spec.name, trace_s, time.perf_counter() - t1,
@@ -774,6 +806,15 @@ def warm_from_bundle(
                     )
                     continue
                 dt = time.perf_counter() - t1
+                from ..utils.costmodel import xla_cost_of
+
+                # MERGE manifest actuals under whatever the deserialized
+                # executable still reports: memory_analysis() can
+                # succeed while cost_analysis() refuses, and a partial
+                # capture must not mask the manifest's flops/bytes
+                xc = dict((manifest_costs or {}).get(spec.name) or {})
+                xc.update(xla_cost_of(compiled) or {})
+                xc = xc or None
                 # hit = no persistent-cache MISS escaped to the
                 # compiler during this kernel's compile. A compile that
                 # raised neither event was deduplicated against an
@@ -786,7 +827,7 @@ def warm_from_bundle(
                 hit = cache_on and _CACHE_EVENTS["misses"] == m0
                 ledger.record(
                     spec.name, trace_s, dt, cache_hit=hit,
-                    shape_key=shape, aot_hit=hit,
+                    shape_key=shape, aot_hit=hit, xla_cost=xc,
                 )
                 if hit:
                     hits += 1
@@ -848,9 +889,15 @@ def load_and_warm(
     stats: dict = {"bundle": bundle.dir, "load_s": bundle.load_s,
                    "skipped_entries": bundle.skipped}
     if aot_warm_enabled():
+        manifest_costs = {
+            k["name"]: k["cost"]
+            for k in bundle.manifest.get("kernels", ())
+            if isinstance(k, dict) and k.get("cost")
+        }
         stats.update(
             warm_from_bundle(
-                assembly, config, mesh_shape=mesh_shape, ledger=ledger
+                assembly, config, mesh_shape=mesh_shape, ledger=ledger,
+                manifest_costs=manifest_costs,
             )
         )
     return stats

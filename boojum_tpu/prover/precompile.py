@@ -775,6 +775,11 @@ def precompile(
                 assembly, config, mesh_shape=mesh_shape
             )
     _metrics.count("precompile.kernels", len(specs))
+    # warm the analytic cost sheet from this enumeration so the first
+    # recorded prove's cost seam never re-walks it inside its span
+    from ..utils import costmodel as _costmodel
+
+    _costmodel.prime_sheet(assembly, config, specs, mesh_shape=mesh_shape)
 
     lowered = []
     with _span("precompile_lower", kernels=len(specs)):
@@ -801,7 +806,7 @@ def precompile(
         spec, trace_s, low = item
         t0 = time.perf_counter()
         try:
-            low.compile()
+            compiled = low.compile()
         except Exception as e:  # noqa: BLE001
             ledger.record(
                 spec.name, trace_s, time.perf_counter() - t0, error=repr(e),
@@ -810,11 +815,16 @@ def precompile(
             _metrics.count("precompile.compile_errors")
             return
         dt = time.perf_counter() - t0
+        # compile-time cost actuals (ISSUE 12): the executable's own
+        # flops / bytes-accessed — the analytic cost sheet's
+        # cross-check axis, carried per kernel in the ledger
+        from ..utils.costmodel import xla_cost_of
+
         # sub-100ms "compiles" are persistent-cache loads in practice —
         # a heuristic, but the ledger's monitoring counters carry the
         # authoritative process-wide hit/miss totals
         ledger.record(spec.name, trace_s, dt, cache_hit=dt < 0.1,
-                      shape_key=shape)
+                      shape_key=shape, xla_cost=xla_cost_of(compiled))
 
     def _weight(item):
         # schedule the biggest modules first: with K workers and a handful

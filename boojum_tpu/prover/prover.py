@@ -1089,6 +1089,13 @@ def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:
     clock = _StageClock()
     _metrics.count("prover.proves")
     with _span("prove", trace_len=assembly.trace_len):
+        # measured-traffic baseline BEFORE any of this prove's work: on
+        # a long-lived registry (bench multi-rep) the ici./transfer.
+        # families are cumulative, and the cost record must carry this
+        # prove's bytes only
+        from ..utils import costmodel as _costmodel
+
+        cost_baseline = _costmodel.measured_baseline()
         # AOT consult INSIDE the recorded region (flight recorder is
         # installed by now), so aot.* counters/gauges and the
         # aot_load/aot_warm spans land on this prove's report line;
@@ -1100,8 +1107,18 @@ def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:
         try:
             if mesh is not None:
                 with prover_mesh(mesh):
-                    return _prove_impl(assembly, setup, config, clock)
-            return _prove_impl(assembly, setup, config, clock)
+                    proof = _prove_impl(assembly, setup, config, clock)
+            else:
+                proof = _prove_impl(assembly, setup, config, clock)
+            clock.stop()
+            # roofline attribution (ISSUE 12): every stage span is
+            # closed now — join the analytic cost model with this
+            # prove's walls/gauges/ledger actuals and stamp the `cost`
+            # record on the report line (fails soft inside)
+            _costmodel.attach_cost_record(
+                assembly, config, mesh=mesh, baseline=cost_baseline
+            )
+            return proof
         except BaseException as e:
             clock.stop(error=e)
             raise

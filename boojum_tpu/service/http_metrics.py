@@ -184,7 +184,32 @@ class MetricsPlane:
 
     # ---- endpoint bodies (pure, unit-testable without sockets) -----------
     def render_metrics(self) -> str:
-        return prometheus_text(self.sampler.registry.to_dict())
+        """Prometheus text of the sampler's registry MERGED with the
+        process-global default metrics registry (ISSUE 12 satellite):
+        the prove counters — `ici.*`, `limb.*`, `aot.*`, `quotient.*`,
+        `fri.*`, `transfer.*`, `cost.*` — accumulate on the flight
+        recorder's registry, not the sampler's, so without the merge
+        /metrics only ever showed `telemetry.*`. Scoped (per-request)
+        registries stay per-line by design; sampler values win a name
+        collision (they are the fresher snapshot)."""
+        merged: dict = {"counters": {}, "gauges": {}}
+        try:
+            from ..utils import metrics as _metrics
+
+            # the process-global DEFAULT registry only: this handler
+            # thread's context never carries a request-scoped registry,
+            # and per-request collectors belong to their report lines
+            reg = _metrics.current_registry()
+            if reg is not None:
+                snap = reg.to_dict()
+                merged["counters"].update(snap.get("counters") or {})
+                merged["gauges"].update(snap.get("gauges") or {})
+        except Exception:  # noqa: BLE001 — a prove-registry probe must
+            pass           # never take the metrics endpoint down
+        snap = self.sampler.registry.to_dict()
+        merged["counters"].update(snap.get("counters") or {})
+        merged["gauges"].update(snap.get("gauges") or {})
+        return prometheus_text(merged)
 
     def render_health(self) -> dict:
         import time

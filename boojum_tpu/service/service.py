@@ -215,12 +215,28 @@ class ProvingService:
         self.sampler.add_provider(
             "service.queue.tenant", self.queue.tenant_depths
         )
+        # roofline attribution of the most recent prove (ISSUE 12):
+        # per-stage achieved GFLOP/s / GB/s / efficiency ride /metrics
+        # and the telemetry record as telemetry.cost.* gauges
+        from ..utils import costmodel as _costmodel
+
+        self.sampler.add_provider("cost", _costmodel.telemetry_provider)
         # per-tenant byte/compute quota accounting (tenant.QuotaLedger);
         # installed by the gateway — None keeps in-process submit()
         # admission unmetered, exactly as before ISSUE 11
         self.quota = None
         self.metrics_plane = None
         self._owns_sampler_install = False
+        # service-lifetime prove-counter registry: per-request scoped
+        # recorders are torn down with their report line, so /metrics
+        # would never show the ici./limb./aot./quotient./fri./transfer./
+        # cost. families without an accumulator the plane's merge can
+        # read (installed as the process-global default by
+        # start_telemetry; _serve_one folds each request in)
+        from ..utils import metrics as _metrics
+
+        self.prove_registry = _metrics.MetricsRegistry()
+        self._owns_registry_install = False
         # packed proof-parallel mode mutates these from pool threads
         self._stats_lock = threading.Lock()
         self.stats = {
@@ -352,6 +368,14 @@ class ProvingService:
                 _telemetry.install_sampler(self.sampler)
                 self._owns_sampler_install = True
             self.sampler.start()
+        # same adoption rule for the default metrics registry: the
+        # plane's /metrics merge reads current_registry(), and unrecorded
+        # requests (no report_path) then count straight into it
+        from ..utils import metrics as _metrics
+
+        if _metrics.current_registry() is None:
+            _metrics.install_registry(self.prove_registry)
+            self._owns_registry_install = True
         if metrics_port is not None and self.metrics_plane is None:
             from .http_metrics import MetricsPlane
 
@@ -393,6 +417,12 @@ class ProvingService:
             if _telemetry.current_sampler() is self.sampler:
                 _telemetry.install_sampler(None)
             self._owns_sampler_install = False
+        if self._owns_registry_install:
+            from ..utils import metrics as _metrics
+
+            if _metrics.current_registry() is self.prove_registry:
+                _metrics.install_registry(None)
+            self._owns_registry_install = False
 
     def _telemetry_health(self) -> dict:
         with self._stats_lock:
@@ -529,6 +559,13 @@ class ProvingService:
                 except Exception as e:  # noqa: BLE001 — recording must
                     # never turn a served proof into a failure
                     _log(f"service: report write failed: {e!r}")
+                try:
+                    # the scoped registry dies with this block: fold it
+                    # into the service-lifetime one so /metrics keeps
+                    # the prove counter families
+                    self.prove_registry.fold(rec.metrics)
+                except Exception:  # noqa: BLE001
+                    pass
         return ok
 
     def _charge_quota(self, req: ProveRequest, rec=None) -> dict | None:

@@ -76,6 +76,20 @@ class MetricsRegistry:
         with self._lock:
             self.boundaries.append(entry)
 
+    def fold(self, other: "MetricsRegistry"):
+        """Accumulate another registry's snapshot into this one:
+        counters ADD (events keep counting across requests), gauges
+        LAST-WRITE (a gauge is a current-value reading, Prometheus
+        semantics). The proving service folds each request's scoped
+        registry into its service-lifetime one so /metrics shows the
+        prove counter families after the per-request recorder is
+        torn down."""
+        snap = other.to_dict()
+        with self._lock:
+            for k, v in (snap.get("counters") or {}).items():
+                self.counters[k] = self.counters.get(k, 0) + int(v)
+            self.gauges.update(snap.get("gauges") or {})
+
     def to_dict(self) -> dict:
         with self._lock:
             return {
@@ -252,6 +266,16 @@ def gauge_aot_add(name: str, v: float):
     reg = current_registry()
     if reg is not None:
         reg.gauge_add(f"aot.{name}", float(v))
+
+
+def gauge_set_cost(name: str, v: float):
+    """Set a `cost.<name>` gauge (the roofline record's per-stage
+    achieved GFLOP/s, GB/s and efficiency fractions — utils/costmodel.py
+    exports them here so /metrics and the report line's gauges carry the
+    same numbers the `cost` record does)."""
+    reg = current_registry()
+    if reg is not None:
+        reg.gauge_set(f"cost.{name}", float(v))
 
 
 def gauge_service(name: str, v: float):

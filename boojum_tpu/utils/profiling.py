@@ -217,7 +217,8 @@ class CompileLedger:
     # -- explicit source (precompile.py / service warm-up) -----------------
     def record(self, name: str, trace_s: float, compile_s: float,
                cache_hit: bool | None = None, error: str | None = None,
-               shape_key: str | None = None, aot_hit: bool | None = None):
+               shape_key: str | None = None, aot_hit: bool | None = None,
+               xla_cost: dict | None = None):
         """`shape_key` is the canonical shape-bucket key of the
         (assembly, config) pair this kernel belongs to
         (prover/shape_key.py) — the SAME key the service admission queue
@@ -227,7 +228,11 @@ class CompileLedger:
         DESERIALIZATION (True) or escaped to a real compile (False) —
         the summary splits `aot_hits`/`aot_misses`/`aot_deserialize_s`
         from ordinary compiles so a warm-up wall is attributable to the
-        right bill."""
+        right bill. `xla_cost` (ISSUE 12) is the executable's
+        compile-time actuals — `compiled.cost_analysis()` flops /
+        bytes-accessed plus `memory_analysis()` sizes, captured by
+        precompile/aot warm via costmodel.xla_cost_of — the axis the
+        analytic cost sheet cross-checks against."""
         with self._lock:
             entry = {
                 "name": name,
@@ -240,9 +245,26 @@ class CompileLedger:
                 entry["shape"] = shape_key
             if aot_hit is not None:
                 entry["aot_hit"] = bool(aot_hit)
+            if xla_cost:
+                entry["cost"] = dict(xla_cost)
             if error is not None:
                 entry["error"] = error
             self.entries.append(entry)
+
+    def kernel_costs(self, shape_key: str | None = None) -> dict:
+        """{kernel_name: xla_cost dict} over every entry that captured
+        compile-time actuals (last recording of a name wins — a re-warm
+        refreshes the actuals). The ledger is process-global and kernel
+        names are not shape-qualified, so a multi-bucket process MUST
+        pass its bucket's `shape_key` or another bucket's compiles get
+        attributed to this one."""
+        with self._lock:
+            return {
+                e["name"]: e["cost"]
+                for e in self.entries
+                if "cost" in e
+                and (shape_key is None or e.get("shape") == shape_key)
+            }
 
     # -- passive sources ---------------------------------------------------
     def _on_duration(self, event: str, duration: float, **kw):
@@ -305,6 +327,12 @@ class CompileLedger:
         aot_hits = sum(1 for e in aot_entries if e["aot_hit"])
         return {
             "num_kernels": len(entries),
+            # the recorded kernel-name set: the report validator rejects
+            # a `cost` record claiming kernels this ledger never saw
+            # (ISSUE 12) — attribution must never outrun the evidence
+            "kernel_names": sorted({e["name"] for e in entries}),
+            # how many kernels carry compile-time XLA cost actuals
+            "cost_kernels": sum(1 for e in entries if "cost" in e),
             "shapes": shapes,
             # AOT artifact accounting (prover/aot.py warm pass): kernels
             # satisfied by executable DESERIALIZATION vs ones that

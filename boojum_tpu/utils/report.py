@@ -34,10 +34,13 @@ import time
 REPORT_KIND = "boojum_tpu.prove_report"
 # schema 2 (ISSUE 9): lines may carry a `telemetry` record (background
 # sampler time series, utils/telemetry.py) and a `trace` record (an
-# on-demand jax.profiler capture attributable to the line); schema-1
-# lines remain valid for --check/--diff
-REPORT_SCHEMA = 2
-ACCEPTED_SCHEMAS = (1, 2)
+# on-demand jax.profiler capture attributable to the line); schema 3
+# (ISSUE 12): lines may carry a `cost` record (utils/costmodel.py —
+# per-stage analytic flops/bytes joined with measured walls into
+# achieved GFLOP/s & GB/s, roofline regime and efficiency-vs-peak);
+# older-schema lines remain valid for --check/--diff
+REPORT_SCHEMA = 3
+ACCEPTED_SCHEMAS = (1, 2, 3)
 
 # canonical Fiat–Shamir round order; validation checks checkpoint rounds
 # never decrease along the stream
@@ -151,6 +154,10 @@ class FlightRecorder:
         # window (profiling.maybe_trace_capture) — lands in the report
         # line's `trace` record so the trace is attributable
         self.trace_dir: str | None = None
+        # the roofline cost record (utils/costmodel.attach_cost_record
+        # stamps it at the end of a successful prove) — lands as the
+        # line's schema-3 `cost` record
+        self.cost: dict | None = None
 
     def close(self):
         if self.wall_s is None:
@@ -230,6 +237,8 @@ def build_report(rec: FlightRecorder, extra: dict | None = None) -> dict:
     }
     if rec.trace_dir:
         d["trace"] = {"dir": rec.trace_dir}
+    if getattr(rec, "cost", None):
+        d["cost"] = rec.cost
     try:
         # the live telemetry plane's time series (utils/telemetry.py):
         # when a sampler is running, every report line carries the
@@ -252,10 +261,16 @@ def build_report(rec: FlightRecorder, extra: dict | None = None) -> dict:
     try:
         import jax
 
-        d["host"] = {
-            "platform": jax.default_backend(),
-            "process_index": jax.process_index(),
-        }
+        # the SAME identity block bench/bench_micro stamp — the five
+        # fields _trend_identity groups gated series by, so report
+        # artifacts from two machines never share a series
+        from ..prover.aot import platform_info
+
+        d["host"] = dict(
+            platform_info(),
+            platform=jax.default_backend(),
+            process_index=jax.process_index(),
+        )
     except Exception:
         pass
     if extra:
@@ -306,13 +321,69 @@ def flatten_spans(report: dict) -> list[tuple[str, dict]]:
     ]
 
 
-def span_coverage(report: dict) -> float:
-    """Fraction of the root prove span's wall covered by its direct
-    children (the stage spans). 0.0 when there is no usable tree."""
-    spans = report.get("spans") or []
-    root = next((s for s in spans if s.get("name") == "prove"), None)
+# the prover's stage spans (prover._StageClock start calls) — the
+# canonical per-round series both the roofline record
+# (utils/costmodel.STAGE_NAMES aliases this) and the trend gate key on.
+# Cache-state spans that also land under `prove` (aot_load, aot_warm,
+# overlap_prefetch) are deliberately NOT stages: gating them would fail
+# CI on artifact-store temperature, not prover performance.
+PROVE_STAGES = (
+    "round1_witness_commit",
+    "round2_stage2_commit",
+    "round3_quotient",
+    "round4_evaluations",
+    "round5_deep_fri",
+    "queries",
+)
+
+# the cache-state spans themselves, for the places that must SUBTRACT
+# them (the trend total_wall point) rather than merely not enumerate
+# them (stage series)
+CACHE_STATE_SPANS = ("aot_load", "aot_warm", "overlap_prefetch")
+
+
+def _prove_root(spans):
+    """The span a line's stage/coverage numbers describe: the `prove`
+    span found ANYWHERE in the tree (the proving service nests it under
+    its `service_request` root), first root as fallback. The LAST
+    matching `prove` span wins: a long-lived bench/CLI recorder can
+    hold several proves, and the numbers must come from the prove that
+    just finished, not the first one."""
+    spans = spans or []
+    root = None
+    for _path, sp in _walk_spans(spans):
+        if sp.get("name") == "prove":
+            root = sp
     if root is None and spans:
         root = spans[0]
+    return root
+
+
+def stage_walls(spans, names=None) -> dict:
+    """{stage_name: summed wall_s} over the DIRECT children of the
+    prove root (_prove_root). The one span-tree extraction both the
+    roofline record (utils/costmodel.py) and the trend series share, so
+    the perf gate and the cost record can never disagree about what a
+    stage's wall is. `names` filters to a known stage set; None takes
+    every child."""
+    root = _prove_root(spans)
+    walls: dict = {}
+    for c in (root or {}).get("children", ()):
+        nm = c.get("name")
+        w = c.get("wall_s")
+        if names is not None and nm not in names:
+            continue
+        if isinstance(w, (int, float)):
+            walls[nm] = walls.get(nm, 0.0) + float(w)
+    return walls
+
+
+def span_coverage(report: dict) -> float:
+    """Fraction of the prove root's wall covered by its direct children
+    (the stage spans) — the SAME root selection as stage_walls, so one
+    line's coverage and stage numbers always describe the same prove.
+    0.0 when there is no usable tree."""
+    root = _prove_root(report.get("spans") or [])
     if not root or not root.get("wall_s"):
         return 0.0
     covered = sum(
@@ -628,12 +699,108 @@ def validate_report(report: dict) -> list[str]:
     telemetry = report.get("telemetry")
     if telemetry is not None:
         problems.extend(_validate_telemetry(telemetry))
+    # cost record (schema 3, utils/costmodel.py): the roofline numbers
+    # dashboards and the trend gate key on. A record claiming an
+    # efficiency over a zero/absent denominator (no wall, no positive
+    # peak) or attributing kernels the compile ledger never recorded is
+    # fabricating attribution and must fail the gate.
+    cost = report.get("cost")
+    if cost is not None:
+        problems.extend(
+            _validate_cost(cost, report.get("compile_ledger"))
+        )
     trace = report.get("trace")
     if trace is not None and not (
         isinstance(trace, dict) and isinstance(trace.get("dir"), str)
         and trace["dir"]
     ):
         problems.append(f"trace record malformed: {trace!r}")
+    return problems
+
+
+def _validate_cost(cost, ledger) -> list[str]:
+    if not isinstance(cost, dict):
+        return [f"cost record malformed: {type(cost).__name__}"]
+    problems: list[str] = []
+    device = cost.get("device")
+    if not isinstance(device, dict):
+        problems.append("cost record missing device peaks")
+        device = {}
+
+    def _bad(v):
+        return not isinstance(v, (int, float)) or v != v
+
+    stages = cost.get("stages")
+    if not isinstance(stages, dict) or not stages:
+        problems.append("cost record has no stages")
+        stages = {}
+    entries = dict(stages)
+    if isinstance(cost.get("total"), dict):
+        entries["total"] = cost["total"]
+    peak_by_regime = {
+        "compute": device.get("peak_gflops"),
+        "memory": device.get("peak_hbm_gbps"),
+    }
+    for name, st in entries.items():
+        if not isinstance(st, dict):
+            problems.append(f"cost stage {name}: not a dict")
+            continue
+        for k in ("flops", "hbm_bytes", "ici_bytes"):
+            v = st.get(k)
+            if v is not None and (_bad(v) or v < 0):
+                problems.append(f"cost stage {name}: {k} invalid: {v!r}")
+        wall = st.get("wall_s")
+        if wall is not None and (_bad(wall) or wall < 0):
+            problems.append(f"cost stage {name}: wall_s invalid: {wall!r}")
+        claimed = [
+            k for k in ("achieved_gflops", "achieved_gbps", "efficiency")
+            if st.get(k) is not None
+        ]
+        if claimed and not (
+            isinstance(wall, (int, float)) and wall == wall and wall > 0
+        ):
+            problems.append(
+                f"cost stage {name}: {claimed[0]} claimed over a "
+                f"zero/absent wall (denominator) — wall_s={wall!r}"
+            )
+        for k in claimed:
+            v = st.get(k)
+            if _bad(v) or v < 0:
+                problems.append(f"cost stage {name}: {k} invalid: {v!r}")
+        eff = st.get("efficiency")
+        if eff is not None:
+            regime = st.get("regime")
+            peak = peak_by_regime.get(regime)
+            if not (
+                isinstance(peak, (int, float)) and peak == peak and peak > 0
+            ):
+                problems.append(
+                    f"cost stage {name}: efficiency claimed against a "
+                    f"zero/absent {regime!r} peak (denominator) — "
+                    f"device={peak!r}"
+                )
+    # the attribution cross-check: a cost record may only claim XLA
+    # actuals for kernels the compile ledger actually recorded (the
+    # `kernels` list is the analytic sheet's coverage — informational;
+    # `attributed_kernels` is the evidence claim). Older ledgers without
+    # a kernel-name set skip the check.
+    kernels = cost.get("attributed_kernels")
+    if kernels is not None and not isinstance(kernels, list):
+        problems.append(
+            f"cost attributed_kernels malformed: {type(kernels).__name__}"
+        )
+        kernels = None
+    ledger_names = (
+        ledger.get("kernel_names") if isinstance(ledger, dict) else None
+    )
+    if isinstance(kernels, list) and isinstance(ledger_names, list):
+        alien = sorted(set(map(str, kernels)) - set(map(str, ledger_names)))
+        if alien:
+            problems.append(
+                f"cost record claims XLA actuals for {len(alien)} "
+                f"kernel(s) absent from the compile ledger (attribution "
+                f"outran the evidence): {alien[:5]}"
+            )
     return problems
 
 
@@ -751,6 +918,32 @@ def diff_reports(a: dict, b: dict, top: int = 10) -> dict:
         for k in sorted(set(na) | set(nb))
         if na.get(k) != nb.get(k)
     }
+
+    # cost-record diff (ISSUE 12 satellite): per-stage roofline
+    # efficiency deltas alongside the wall deltas — "round3 got slower"
+    # and "round3 got FURTHER from peak" are different regressions
+    def _cost_stages(r):
+        c = r.get("cost")
+        return (c.get("stages") or {}) if isinstance(c, dict) else {}
+
+    sa, sb_ = _cost_stages(a), _cost_stages(b)
+    cost_deltas = {}
+    for st in sorted(set(sa) | set(sb_)):
+        ea = sa.get(st) if isinstance(sa.get(st), dict) else {}
+        eb = sb_.get(st) if isinstance(sb_.get(st), dict) else {}
+        fa, fb = ea.get("efficiency"), eb.get("efficiency")
+        ga, gb = ea.get("achieved_gflops"), eb.get("achieved_gflops")
+        if fa is None and fb is None and ga is None and gb is None:
+            continue
+        ent = {
+            "efficiency": [fa, fb],
+            "achieved_gflops": [ga, gb],
+            "regime": [ea.get("regime"), eb.get("regime")],
+        }
+        if isinstance(fa, (int, float)) and isinstance(fb, (int, float)):
+            ent["efficiency_delta"] = round(fb - fa, 6)
+        cost_deltas[st] = ent
+
     return {
         "wall_a_s": a.get("wall_s"),
         "wall_b_s": b.get("wall_s"),
@@ -758,6 +951,7 @@ def diff_reports(a: dict, b: dict, top: int = 10) -> dict:
         "first_checkpoint_divergence": first_div,
         "num_checkpoints": [len(ca), len(cb)],
         "counter_deltas": counter_deltas,
+        "cost_deltas": cost_deltas,
     }
 
 
@@ -882,6 +1076,38 @@ def slo_summary(reports: list[dict]) -> dict:
             if isinstance(rs, (int, float)) and rs > 0:
                 resident_lines += 1
 
+    # roofline axis (ISSUE 12): aggregate per-stage efficiency over the
+    # lines carrying a cost record — the "how far from the hardware"
+    # number next to the wall percentiles
+    cost_lines = 0
+    stage_eff: dict[str, list] = {}
+    stage_regimes: dict[str, dict] = {}
+    for r in reports:
+        c = r.get("cost")
+        if not isinstance(c, dict):
+            continue
+        cost_lines += 1
+        for st, ent in (c.get("stages") or {}).items():
+            if not isinstance(ent, dict):
+                continue
+            eff = ent.get("efficiency")
+            if isinstance(eff, (int, float)) and eff == eff:
+                stage_eff.setdefault(st, []).append(float(eff))
+            reg = ent.get("regime")
+            if isinstance(reg, str):
+                slot = stage_regimes.setdefault(st, {})
+                slot[reg] = slot.get(reg, 0) + 1
+    roofline_summary = {
+        "lines": cost_lines,
+        "stages": {
+            st: {
+                "mean_efficiency": round(sum(v) / len(v), 6),
+                "regimes": dict(sorted(stage_regimes.get(st, {}).items())),
+            }
+            for st, v in sorted(stage_eff.items())
+        },
+    }
+
     return {
         # which representation served: lines whose kernels dispatched
         # limb-RESIDENT (ISSUE 10) — BENCH/SLO deltas are attributable
@@ -906,6 +1132,7 @@ def slo_summary(reports: list[dict]) -> dict:
         ),
         "tenants": tenant_summary,
         "rejected": shed,
+        "roofline": roofline_summary,
         "aot_kernels_warmed": aot_hits + aot_misses,
         "aot_hit_rate": (
             round(aot_hits / (aot_hits + aot_misses), 4)
@@ -950,6 +1177,20 @@ def render_slo(summary: dict) -> str:
                 f"{k}={v}" for k, v in summary["priorities"].items()
             )
         )
+    roof = summary.get("roofline") or {}
+    if roof.get("lines"):
+        lines.append(
+            f"  roofline      {roof['lines']} line(s) with cost records"
+        )
+        for st, ent in (roof.get("stages") or {}).items():
+            regimes = ",".join(
+                f"{k}={v}" for k, v in (ent.get("regimes") or {}).items()
+            )
+            lines.append(
+                f"    {st:<24} mean eff "
+                f"{100 * ent['mean_efficiency']:.2f}%"
+                + (f" [{regimes}]" if regimes else "")
+            )
     rejected = summary.get("rejected") or {}
     if any(rejected.values()):
         lines.append(
@@ -1055,6 +1296,16 @@ def render_report(report: dict, top: int = 10) -> str:
     trace = report.get("trace")
     if isinstance(trace, dict):
         lines.append(f"  profiler trace: {trace.get('dir')}")
+    cost = report.get("cost")
+    if isinstance(cost, dict):
+        tot = cost.get("total") or {}
+        lines.append(
+            f"  cost: total {tot.get('achieved_gflops')} GFLOP/s, "
+            f"{tot.get('achieved_gbps')} GB/s, "
+            f"regime={tot.get('regime')} "
+            f"eff={tot.get('efficiency')} (--roofline for the "
+            f"per-stage table)"
+        )
     request = report.get("request")
     if isinstance(request, dict):
         lines.append(
@@ -1114,6 +1365,432 @@ def render_diff(diff: dict) -> str:
         lines.append("counter deltas:")
         for k, (a, b) in diff["counter_deltas"].items():
             lines.append(f"  {k}: {a} -> {b}")
+    if diff.get("cost_deltas"):
+        lines.append("cost (roofline) deltas:")
+        for st, ent in diff["cost_deltas"].items():
+            fa, fb = ent.get("efficiency", [None, None])
+            dl = ent.get("efficiency_delta")
+            dl_s = f" ({dl:+.4f})" if isinstance(dl, (int, float)) else ""
+            ra, rb = ent.get("regime", [None, None])
+            reg = ra if ra == rb else f"{ra}->{rb}"
+            lines.append(
+                f"  {st}: efficiency {fa} -> {fb}{dl_s} [{reg}]"
+            )
+    return "\n".join(lines)
+
+
+def render_roofline(report: dict) -> str:
+    """Render one line's `cost` record as a per-stage roofline table:
+    measured wall, achieved GFLOP/s & GB/s against the device peaks,
+    arithmetic intensity, regime and efficiency fraction."""
+    cost = report.get("cost")
+    if not isinstance(cost, dict):
+        return "no cost record on this line (schema < 3, or " \
+               "BOOJUM_TPU_COST=0 / no flight recorder during the prove)"
+    lines = []
+    dev = cost.get("device") or {}
+    lines.append(
+        f"roofline: device {dev.get('kind')!r} "
+        f"peak {dev.get('peak_gflops')} GFLOP/s, "
+        f"{dev.get('peak_hbm_gbps')} GB/s HBM"
+        + (
+            f", {dev.get('peak_ici_gbps')} GB/s ICI"
+            if dev.get("peak_ici_gbps") else ""
+        )
+        + f" [{dev.get('source')}]"
+    )
+    header = (
+        f"  {'stage':<24} {'wall_s':>10} {'GFLOP/s':>9} {'GB/s':>9}"
+        f" {'int.':>8}  {'regime':<8}{'eff':>8}"
+    )
+    lines.append(header)
+
+    def _num(v, nd=4):
+        return f"{v:.{nd}g}" if isinstance(v, (int, float)) else "-"
+
+    def _row(name, ent):
+        if not isinstance(ent, dict):
+            return
+        eff = ent.get("efficiency")
+        lines.append(
+            f"  {name:<24}"
+            f" {_num(ent.get('wall_s'), 6):>10}"
+            f" {_num(ent.get('achieved_gflops')):>9}"
+            f" {_num(ent.get('achieved_gbps')):>9}"
+            f" {_num(ent.get('intensity_flop_per_byte')):>8}"
+            f"  {ent.get('regime', '-'):<8}"
+            + (f"{100 * eff:>7.2f}%" if isinstance(eff, (int, float))
+               else f"{'-':>8}")
+        )
+
+    for name, ent in (cost.get("stages") or {}).items():
+        _row(name, ent)
+    if isinstance(cost.get("total"), dict):
+        _row("TOTAL", cost["total"])
+    mc = cost.get("model_check")
+    if isinstance(mc, dict):
+        lines.append(
+            f"  model check: {mc.get('covered_kernels')} kernels vs XLA "
+            f"actuals — flops ratio {mc.get('flops_ratio')}, bytes ratio "
+            f"{mc.get('bytes_ratio')}"
+        )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Perf trend + regression gate (ISSUE 12): a per-stage trajectory over a
+# history of artifacts — ProveReport JSONL files, bench.py JSON lines,
+# the repo's BENCH_*.json round wrappers, bench_micro.py line files —
+# with a gate that exits nonzero when the LAST point regresses beyond a
+# noise threshold against the median of its predecessors.
+# ---------------------------------------------------------------------------
+
+# bench statuses whose value is not a steady-state measurement — an
+# elapsed lower bound (no_prove) or a compile-laden warm-up wall
+# (warm_only) — excluded from every trend series
+_TREND_SKIP_STATUSES = ("no_prove", "warm_only")
+
+
+def _trend_identity(d: dict) -> str:
+    """Compact machine/software identity of one artifact line (the
+    `host` block bench.py / bench_micro.py stamp): micro lines from two
+    machines or jax versions must never share a gated series."""
+    h = d.get("host")
+    if not isinstance(h, dict):
+        return ""
+    parts = [
+        str(h.get(k))
+        for k in ("host_fp", "device_kind", "backend", "jax", "jaxlib")
+        if h.get(k) is not None
+    ]
+    return "@".join(parts)
+
+
+def _point_values_from_report(rep: dict) -> dict:
+    values: dict = {}
+    wall = rep.get("wall_s")
+    if isinstance(wall, (int, float)):
+        # total_wall gates prover performance, not artifact-store
+        # temperature: a cold-cache process spends most of its wall in
+        # aot_load/aot_warm (compile/deserialize), and gating on it
+        # would fire on cache state — the same exclusion the stage
+        # series get by keying on PROVE_STAGES
+        w = float(wall)
+        root = _prove_root(rep.get("spans"))
+        for c in (root or {}).get("children", ()):
+            cw = c.get("wall_s")
+            if c.get("name") in CACHE_STATE_SPANS and isinstance(
+                cw, (int, float)
+            ):
+                w -= float(cw)
+        values["total_wall"] = {"value": max(0.0, w), "unit": "s"}
+    for nm, w in stage_walls(
+        rep.get("spans"), names=PROVE_STAGES
+    ).items():
+        values[f"stage:{nm}"] = {"value": w, "unit": "s"}
+    cost = rep.get("cost")
+    if isinstance(cost, dict):
+        for st, ent in (cost.get("stages") or {}).items():
+            eff = ent.get("efficiency") if isinstance(ent, dict) else None
+            if isinstance(eff, (int, float)):
+                values[f"efficiency:{st}"] = {
+                    "value": float(eff), "unit": "frac"
+                }
+    return values
+
+
+def _point_values_from_bench(line: dict) -> dict:
+    values: dict = {}
+    status = str(line.get("status") or "")
+    if any(s in status for s in _TREND_SKIP_STATUSES):
+        return values
+    v, unit = line.get("value"), str(line.get("unit") or "")
+    metric = str(line.get("metric") or "")
+    if isinstance(v, (int, float)):
+        if unit == "s" and metric.endswith("_prove_wall"):
+            values["total_wall"] = {"value": float(v), "unit": "s"}
+        elif metric:
+            values[metric] = {"value": float(v), "unit": unit}
+    stages = line.get("stages")
+    if isinstance(stages, dict):
+        for nm, w in stages.items():
+            if isinstance(w, (int, float)):
+                values[f"stage:{nm}"] = {"value": float(w), "unit": "s"}
+    return values
+
+
+def load_trend_file(path: str) -> list[dict]:
+    """Parse ONE artifact file into trend points (usually one point; a
+    bench_micro line file yields one point carrying every metric).
+    Unparseable files yield an empty list — the caller reports them."""
+    base = os.path.basename(path)
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return []
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    docs = []
+    try:
+        # whole-file JSON first (BENCH_*.json wrappers are indented)
+        docs = [json.loads(text)]
+    except ValueError:
+        for ln in lines:
+            try:
+                docs.append(json.loads(ln))
+            except ValueError:
+                continue
+    if not docs:
+        return []
+    # BENCH round wrapper: {n, cmd, rc, parsed}
+    if len(docs) == 1 and isinstance(docs[0], dict) and "parsed" in docs[0]:
+        parsed = docs[0].get("parsed")
+        order = docs[0].get("n")
+        if not isinstance(parsed, dict):
+            return []
+        values = _point_values_from_bench(parsed)
+        if not values:
+            return []
+        return [{
+            "source": base, "label": base,
+            "order": order if isinstance(order, (int, float)) else None,
+            "identity": _trend_identity(parsed), "values": values,
+        }]
+    reports = [
+        d for d in docs
+        if isinstance(d, dict) and d.get("kind") == REPORT_KIND
+    ]
+    if reports:
+        # a report artifact: the LAST line holding an actual prove span
+        # is the settled (warm) prove — a gateway 429/shed reject line
+        # (wall_s=0.0, no spans) can trail the artifact and must not
+        # become its trend point (a 0.0 baseline fires false
+        # regressions; a 0.0 head masks real ones). The FILE name is
+        # the point label — two artifacts recording the same prove
+        # label ("rep3") must still be distinct trend columns
+        proved = [
+            d for d in reports
+            if any(
+                sp.get("name") == "prove"
+                for _p, sp in _walk_spans(d.get("spans") or [])
+            )
+        ]
+        if not proved:
+            return []
+        rep = proved[-1]
+        values = _point_values_from_report(rep)
+        if not values:
+            return []
+        return [{
+            "source": base, "label": base,
+            "order": None, "identity": _trend_identity(rep),
+            "values": values,
+        }]
+    # bench.py raw line(s) / bench_micro line file: fold every metric
+    # line into one point (micro lines share one run identity)
+    values: dict = {}
+    identity = ""
+    for d in docs:
+        if not isinstance(d, dict):
+            continue
+        values.update(_point_values_from_bench(d))
+        identity = identity or _trend_identity(d)
+    if not values:
+        return []
+    return [{
+        "source": base, "label": base, "order": None,
+        "identity": identity, "values": values,
+    }]
+
+
+def load_trend_points(paths: list[str]) -> tuple[list[dict], list[str]]:
+    """Expand paths (directories glob *.json/*.jsonl, sorted by name;
+    files load directly, in the order given) into trend points plus
+    notes about anything skipped."""
+    files: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            names = sorted(os.listdir(p))
+            files.extend(
+                os.path.join(p, n) for n in names
+                if n.endswith((".json", ".jsonl"))
+            )
+        else:
+            files.append(p)
+    points: list[dict] = []
+    notes: list[str] = []
+    for f in files:
+        pts = load_trend_file(f)
+        for p in pts:
+            p["path"] = f
+        if pts:
+            points.extend(pts)
+        else:
+            notes.append(f"{f}: no usable trend data (skipped)")
+    # BENCH round wrappers carry the round number `n`: order THOSE
+    # points by it (in place, other points keep their CLI/filename
+    # positions) — lexicographic filenames put r10 before r9 otherwise
+    idxs = [
+        i for i, p in enumerate(points)
+        if isinstance(p.get("order"), (int, float))
+    ]
+    for i, p in zip(
+        idxs, sorted((points[i] for i in idxs), key=lambda p: p["order"])
+    ):
+        points[i] = p
+    # duplicate labels (runA/report.jsonl vs runB/report.jsonl) would
+    # collapse into one rendered column: disambiguate with the parent
+    # directory
+    seen: dict = {}
+    for p in points:
+        seen[p["label"]] = seen.get(p["label"], 0) + 1
+    for p in points:
+        if seen[p["label"]] > 1:
+            parent = os.path.basename(os.path.dirname(p.get("path", "")))
+            if parent:
+                p["label"] = f"{parent}/{p['label']}"
+    return points, notes
+
+
+def _series_direction(unit: str) -> str | None:
+    """'lower' / 'higher' = which direction is BETTER; None = not
+    gated (dimensionless series ride the table only)."""
+    if unit == "s":
+        return "lower"
+    if unit.endswith("/s"):
+        return "higher"
+    return None
+
+
+def trend_series(points: list[dict]) -> dict:
+    """{(identity, series_name): {"unit", "points": [(label, value)]}}
+    in artifact order.
+
+    Legacy artifacts predate the identity block (identity "") — when a
+    metric's points span the empty identity and exactly ONE real one,
+    the legacy points join that identity's series, so the repo's
+    pre-identity BENCH history keeps gating new identity-stamped runs
+    instead of being silently orphaned into an ungated 1-point series.
+    Two or more real identities keep the split: attributing unlabeled
+    history to one of several machines would gate apples against
+    oranges."""
+    idents_by_name: dict = {}
+    for pt in points:
+        ident = pt.get("identity") or ""
+        for name in (pt.get("values") or {}):
+            idents_by_name.setdefault(name, set()).add(ident)
+    adopt = {}
+    for name, idents in idents_by_name.items():
+        real = sorted(i for i in idents if i)
+        if "" in idents and len(real) == 1:
+            adopt[name] = real[0]
+    out: dict = {}
+    for pt in points:
+        for name, ent in (pt.get("values") or {}).items():
+            ident = pt.get("identity") or ""
+            if not ident:
+                ident = adopt.get(name, "")
+            slot = out.setdefault(
+                (ident, name), {"unit": ent.get("unit", ""), "points": []}
+            )
+            slot["points"].append((pt.get("label"), float(ent["value"])))
+    return out
+
+
+def trend_gate(
+    series: dict,
+    threshold: float = 0.2,
+    min_abs_s: float = 0.05,
+    min_points: int = 2,
+) -> list[dict]:
+    """Regression verdicts: for every gated series with >= min_points
+    points, compare the LAST point against the MEDIAN of its
+    predecessors; a lower-is-better series regresses when the last point
+    exceeds baseline*(1+threshold) (and, for seconds, by at least
+    min_abs_s — sub-50ms jitter is noise, not regression); a
+    higher-is-better series regresses below baseline*(1-threshold)."""
+    regressions = []
+    for (identity, name), slot in sorted(series.items()):
+        direction = _series_direction(slot.get("unit", ""))
+        if direction is None:
+            continue
+        pts = slot["points"]
+        if len(pts) < max(2, min_points):
+            continue
+        prior = sorted(v for _l, v in pts[:-1])
+        base = _percentile(prior, 0.5)
+        last_label, last = pts[-1]
+        if base is None or base != base:
+            continue
+        bad = False
+        if direction == "lower":
+            bad = (
+                last > base * (1.0 + threshold)
+                and (slot.get("unit") != "s" or (last - base) >= min_abs_s)
+            )
+        else:
+            bad = last < base * (1.0 - threshold)
+        if bad:
+            regressions.append({
+                "series": name,
+                "identity": identity,
+                "baseline": round(base, 6),
+                "last": round(last, 6),
+                "last_label": last_label,
+                "ratio": round(last / base, 4) if base else None,
+                "direction": direction,
+            })
+    return regressions
+
+
+def render_trend(
+    series: dict,
+    regressions: list[dict] | None = None,
+    labels: list | None = None,
+) -> str:
+    """Text trajectory table: one row per series, one column per
+    artifact, regressed series flagged. `labels` pins the column order
+    to the ARTIFACT order (pass `[p["label"] for p in points]`); the
+    fallback — first appearance across series — can interleave columns
+    when early artifacts lack the first series."""
+    regressed = {
+        (r["identity"], r["series"]) for r in (regressions or ())
+    }
+    lines = []
+    if labels is not None:
+        ordered: list = []
+        for lb in labels:
+            if lb not in ordered:
+                ordered.append(lb)
+        labels = ordered
+    else:
+        labels = []
+        for slot in series.values():
+            for lbl, _v in slot["points"]:
+                if lbl not in labels:
+                    labels.append(lbl)
+    lines.append(
+        "trend over " + " -> ".join(str(lb) for lb in labels)
+    )
+    for (identity, name), slot in sorted(series.items()):
+        by_label = dict(slot["points"])
+        cells = []
+        for lbl in labels:
+            v = by_label.get(lbl)
+            cells.append(f"{v:.4g}" if isinstance(v, float) else "-")
+        flag = "  << REGRESSED" if (identity, name) in regressed else ""
+        ident = f" [{identity}]" if identity else ""
+        lines.append(
+            f"  {name:<28}{ident} "
+            + " | ".join(f"{c:>9}" for c in cells)
+            + f"  ({slot.get('unit')}){flag}"
+        )
+    for r in regressions or ():
+        lines.append(
+            f"REGRESSION: {r['series']} {r['baseline']} -> {r['last']} "
+            f"(x{r['ratio']}, {r['direction']}-is-better, "
+            f"last={r['last_label']})"
+        )
     return "\n".join(lines)
 
 

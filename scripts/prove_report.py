@@ -34,10 +34,15 @@ Usage:
       gateway-admitted request line MISSING its tenant record fails,
       quota charges must be finite and non-negative, and a 429/load-shed
       rejection line must never carry a prove wall — nothing was
-      proved), and the context-scoping invariant — a line whose
+      proved), the context-scoping invariant — a line whose
       spans/request record mix TWO request ids means the packed
-      service's scoped collectors bled across requests, and FAILS.
-      Exits 1 on any problem.
+      service's scoped collectors bled across requests, and FAILS —
+      and the schema-3 `cost` record (ISSUE 12): a negative or
+      zero-denominator efficiency claim (achieved rates over a
+      zero/absent wall, efficiency against a zero/absent device peak)
+      FAILS, and a record claiming XLA actuals for kernels the compile
+      ledger never recorded FAILS (attribution must never outrun the
+      evidence). Exits 1 on any problem.
 
   python scripts/prove_report.py --slo <report.jsonl>
       Aggregate the per-request SLO records of a proving-service
@@ -49,6 +54,26 @@ Usage:
       An artifact with ZERO request records (plain proves,
       bench reps) has no serving span to aggregate — that is reported
       explicitly and exits 0 (nothing to summarize is not a failure).
+
+  python scripts/prove_report.py --roofline <report.jsonl> [--index -1]
+      Render the line's `cost` record (ISSUE 12): per-stage achieved
+      GFLOP/s & GB/s against the device's nominal peaks, arithmetic
+      intensity, compute-vs-memory roofline regime and efficiency
+      fraction, plus the analytic-model-vs-XLA-actuals agreement
+      ratios. Exits 1 when the line has no cost record.
+
+  python scripts/prove_report.py --trend PATH [PATH...] [--gate]
+      Per-stage perf trajectory over a history of artifacts — report
+      .jsonl files, bench.py JSON lines, BENCH_*.json round wrappers,
+      bench_micro.py line files; directories expand to their
+      *.json/*.jsonl sorted by name. Series are grouped by the
+      machine/software identity block when lines carry one, so micro
+      numbers from different hosts or jax versions never gate each
+      other. With --gate, the LAST point of every series is compared
+      against the MEDIAN of its predecessors and the command exits 1
+      when any stage/metric regresses beyond --gate-threshold (default
+      0.2 = 20%, plus a 50 ms absolute floor for wall series) — the
+      CI-able perf gate.
 
 Reports come from BOOJUM_TPU_REPORT=<path> (any prove), bench.py (labeled
 warm-up/rep lines), scripts/multihost_worker.py (per-host files) or
@@ -107,6 +132,26 @@ def main(argv=None) -> int:
              "proofs/sec, placements)",
     )
     ap.add_argument(
+        "--roofline", metavar="REPORT",
+        help="render the line's cost record: per-stage achieved "
+             "GFLOP/s & GB/s, roofline regime, efficiency vs peak",
+    )
+    ap.add_argument(
+        "--trend", nargs="+", metavar="PATH",
+        help="per-stage perf trajectory over report artifacts / "
+             "BENCH_*.json history / bench_micro line files "
+             "(directories expand to *.json|*.jsonl)",
+    )
+    ap.add_argument(
+        "--gate", action="store_true",
+        help="with --trend: exit 1 when the last point of any series "
+             "regresses beyond the noise threshold",
+    )
+    ap.add_argument(
+        "--gate-threshold", type=float, default=0.2,
+        help="relative regression threshold for --gate (default 0.2)",
+    )
+    ap.add_argument(
         "--index", type=int, default=-1,
         help="which JSONL line to use (default: last)",
     )
@@ -154,6 +199,35 @@ def main(argv=None) -> int:
             )
             return 0
         print(rl.render_slo(summary))
+        return 0
+
+    if args.roofline:
+        rep = rl.load_report(args.roofline, args.index)
+        print(rl.render_roofline(rep))
+        return 0 if isinstance(rep.get("cost"), dict) else 1
+
+    if args.trend:
+        points, notes = rl.load_trend_points(args.trend)
+        for n in notes:
+            print(n, file=sys.stderr)
+        if not points:
+            print("no usable trend points")
+            return 2
+        series = rl.trend_series(points)
+        regressions = rl.trend_gate(
+            series, threshold=args.gate_threshold
+        )
+        print(rl.render_trend(
+            series, regressions, labels=[p["label"] for p in points]
+        ))
+        if args.gate:
+            if regressions:
+                print(
+                    f"GATE: {len(regressions)} series regressed beyond "
+                    f"{args.gate_threshold:.0%}"
+                )
+                return 1
+            print("GATE: ok")
         return 0
 
     if args.diff:

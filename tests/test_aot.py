@@ -263,6 +263,35 @@ def test_serve_report_line_validates_aot_schema():
     assert any("cache misses" in p for p in probs), probs
 
 
+def test_cold_process_carries_cost_actuals():
+    """ISSUE 12 acceptance: the AOT bundle manifest persists per-kernel
+    XLA cost actuals captured at BUILD time, and the zero-compile cold
+    serve process still stamps a fully-attributed `cost` record — no
+    recompilation needed to attribute flops/bytes."""
+    _tmp, build, serve = _roundtrip()
+    with_cost = [k for k in build["kernels"] if k.get("cost")]
+    assert len(with_cost) >= 0.8 * build["num_kernels"], (
+        f"only {len(with_cost)}/{build['num_kernels']} manifest kernels "
+        f"carry cost actuals"
+    )
+    assert all(
+        isinstance(k["cost"].get("bytes_accessed"), (int, float))
+        for k in with_cost
+    )
+    line = serve["report_line"]
+    cost = line.get("cost")
+    assert isinstance(cost, dict), "cold serve line missing cost record"
+    mc = cost.get("model_check")
+    assert mc and mc["covered_kernels"] >= 0.8 * build["num_kernels"], mc
+    ledger = line["compile_ledger"]
+    assert set(cost.get("attributed_kernels") or []) <= set(
+        ledger["kernel_names"]
+    )
+    # still a zero-compile process — the actuals came from the warm
+    # pass / manifest, not from fresh compiles
+    assert serve["summary"]["cache_misses"] == 0
+
+
 def test_slo_view_surfaces_artifact_hit_rate():
     _tmp, build, serve = _roundtrip()
     summary = report.slo_summary([serve["report_line"]])
@@ -460,3 +489,34 @@ def test_bench_prune_protects_current_run_and_bundle_entries(tmp_path):
     # bundle-installed and freshly-touched stems survive; old ones die
     assert {"bundle1-cache", "bundle1-atime", "fresh1-cache"} <= left
     assert "old1-cache" not in left and "old2-cache" not in left
+
+
+def test_platform_info_does_not_memoize_failed_probe(monkeypatch):
+    """A first call racing device availability (backend not up yet)
+    must not pin device_kind='unknown' for the process lifetime — that
+    would reject every bundle load and mis-identify every report
+    line. Only a successful probe is memoized."""
+    import jax
+
+    from boojum_tpu.prover import aot
+
+    saved = aot._PLATFORM_INFO
+    try:
+        aot._PLATFORM_INFO = None
+
+        def _boom():
+            raise RuntimeError("backend not initialized")
+
+        monkeypatch.setattr(jax, "devices", _boom)
+        monkeypatch.setattr(jax, "device_count", _boom)
+        bad = aot.platform_info()
+        assert bad["device_kind"] == "unknown"
+        assert bad["num_devices"] == 0
+        assert aot._PLATFORM_INFO is None  # failure NOT cached
+        monkeypatch.undo()
+        good = aot.platform_info()
+        assert good["device_kind"] != "unknown"
+        assert good["num_devices"] >= 1
+        assert aot._PLATFORM_INFO is not None  # success memoized
+    finally:
+        aot._PLATFORM_INFO = saved

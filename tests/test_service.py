@@ -618,3 +618,43 @@ def test_packed_proof_parallel_parity_with_recording(monkeypatch):
         capture_output=True, text=True, timeout=120,
     )
     assert chk.returncode == 0, chk.stdout + chk.stderr
+
+
+def test_service_metrics_plane_exports_prove_families():
+    """/metrics in SERVICE mode must render the prove counter families
+    even though each request records into a scoped registry that dies
+    with its report line: start_telemetry adopts the process-global
+    default slot with the service-lifetime accumulator, _serve_one
+    folds each request's registry in, stop_telemetry releases."""
+    from boojum_tpu.service import ProvingService, ServiceConfig
+    from boojum_tpu.utils import metrics as _metrics
+
+    svc = ProvingService(
+        ServiceConfig(precompile="off", report_path=None)
+    )
+    prev = _metrics.install_registry(None)
+    try:
+        port = svc.start_telemetry(metrics_port=0)
+        assert port
+        assert _metrics.current_registry() is svc.prove_registry
+        # stand-in for a request's scoped registry (torn down with the
+        # line): the fold keeps its families for the plane's merge
+        req_reg = _metrics.MetricsRegistry()
+        req_reg.count("fri.folds", 4)
+        req_reg.count("transfer.h2d_bytes", 123)
+        req_reg.gauge_set("cost.total.efficiency", 0.5)
+        svc.prove_registry.fold(req_reg)
+        text = svc.metrics_plane.render_metrics()
+        assert "boojum_tpu_fri_folds 4" in text
+        assert "boojum_tpu_transfer_h2d_bytes 123" in text
+        assert "boojum_tpu_cost_total_efficiency 0.5" in text
+        # a second fold ADDS counters, last-writes gauges
+        svc.prove_registry.fold(req_reg)
+        text = svc.metrics_plane.render_metrics()
+        assert "boojum_tpu_fri_folds 8" in text
+        assert "boojum_tpu_cost_total_efficiency 0.5" in text
+        svc.stop_telemetry()
+        assert _metrics.current_registry() is None
+    finally:
+        svc.stop_telemetry()
+        _metrics.install_registry(prev)

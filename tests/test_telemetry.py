@@ -107,7 +107,7 @@ def test_installed_sampler_rides_report_lines():
         line = report.build_report(rec)
     finally:
         telemetry.install_sampler(prev)
-    assert line["schema"] == 2
+    assert line["schema"] == report.REPORT_SCHEMA
     assert line["telemetry"]["ticks"] == 1
     assert report.validate_report(line) == []
     # without a sampler, no record (and schema-1 lines stay valid)
@@ -514,7 +514,7 @@ def test_service_worker_loop_serves_live_plane(eight_devices, tmp_path):
     req_lines = [ln for ln in lines if "request" in ln]
     assert len(req_lines) == 2
     for ln in req_lines:
-        assert ln["schema"] == 2
+        assert ln["schema"] == report.REPORT_SCHEMA
         assert ln["telemetry"]["ticks"] >= 1
         assert report.validate_report(ln) == [], ln["request"]["id"]
     # the satellite's tier-1 gate: --check the freshly generated
