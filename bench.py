@@ -83,6 +83,12 @@ Environment knobs:
       compile_ledger.json next to this file)
   BENCH_LOG_COMPILES = 0 disables jax_log_compiles (on by default so the
       ledger can attribute dispatch-time compiles to graph names)
+  BOOJUM_TPU_BLACKBOX / BOOJUM_TPU_STALL_S arm the black-box recorder
+      (boojum_tpu/utils/blackbox.py): crash-safe heartbeat sidecar +
+      stall/SIGTERM stack dumps into the report artifact (ISSUE 15)
+  BENCH_SETUP_DEADLINE_S / BENCH_WARMUP_DEADLINE_S / BENCH_REP_DEADLINE_S
+      per-phase blackbox deadline alarms (defaults 300/600/60; =0
+      disables one; no-ops when the blackbox is not armed)
   BOOJUM_TPU_REPORT = <path.jsonl> records every prove (warm-up + reps)
       through the flight recorder and appends one labeled ProveReport
       JSONL line each: hierarchical span tree, metrics (device memory,
@@ -333,7 +339,41 @@ _LIVE_SINK = {"sink": None}
 # setup — exactly where BENCH_r03/r04 burned their budgets) falls back
 # to it, so those phases' spans (precompile_compile_pool, aot_load,
 # aot_warm, setup stages) localize the stall too.
-_LIVE_REC = {"rec": None, "bench": None}
+_LIVE_REC = {"rec": None, "bench": None, "flight": None}
+
+
+def _set_phase(name):
+    """One phase transition: the bench JSON line's `phase` field and the
+    blackbox heartbeat stream (utils/blackbox.py) must never disagree
+    about where the budget went."""
+    _STATE["phase"] = name
+    try:
+        from boojum_tpu.utils import blackbox as _bb
+
+        _bb.set_phase(name)
+    except Exception:
+        pass
+
+
+def _phase_deadline(name, env, default_s):
+    """A blackbox deadline alarm for one phase ("setup may take 300 s, a
+    rep may take 60 s") — expiry produces a LOCALIZED stack dump instead
+    of a silent global watchdog line. A no-op nullcontext when no
+    blackbox is armed or the env var disables it (=0)."""
+    import contextlib
+
+    try:
+        from boojum_tpu.utils import blackbox as _bb
+
+        bb = _bb.current_blackbox()
+        if bb is None:
+            return contextlib.nullcontext()
+        budget = float(os.environ.get(env, "") or default_s)
+        if budget <= 0:
+            return contextlib.nullcontext()
+        return bb.deadline(name, budget)
+    except Exception:
+        return contextlib.nullcontext()
 
 
 def _partial_span_tree():
@@ -407,6 +447,10 @@ def _prove_recorded(label, fn):
 
     with _report.flight_recording(label=label) as rec:
         _LIVE_REC["rec"] = rec.spans
+        # the watchdog flushes THIS recorder's partial line if the prove
+        # is still in flight when the budget dies (os._exit skips the
+        # finally below — exactly how r03/r04 lost their artifacts)
+        _LIVE_REC["flight"] = rec
         try:
             out = fn()
             _LIVE_REC["rec"] = None
@@ -418,6 +462,7 @@ def _prove_recorded(label, fn):
             try:
                 _report.append_jsonl(path, _report.build_report(rec))
                 _log(f"ProveReport line ({label}) appended to {path}")
+                _LIVE_REC["flight"] = None
             except Exception as e:  # recorder must never sink the bench
                 _log(f"ProveReport write failed: {e!r}")
     return out
@@ -556,6 +601,32 @@ def _emit(status):
         print(json.dumps(out), flush=True)
 
 
+def _flush_report_artifact():
+    """ISSUE 15 satellite: make the BOOJUM_TPU_REPORT artifact durable
+    BEFORE the timeout JSON line prints. The r03/r04 rounds left NO
+    partial JSONL because the in-flight prove's report line is appended
+    in a finally that os._exit never reaches — so the watchdog appends
+    that partial line itself, then fsyncs the artifact."""
+    path = os.environ.get("BOOJUM_TPU_REPORT")
+    if not path:
+        return
+    flight = _LIVE_REC.get("flight")
+    if flight is not None:
+        try:
+            from boojum_tpu.utils import report as _report
+
+            _report.append_jsonl(path, _report.build_report(flight))
+            _log(f"partial ProveReport line flushed to {path}")
+        except Exception as e:
+            _log(f"partial ProveReport flush failed: {e!r}")
+    try:
+        with open(path, "a") as f:
+            f.flush()
+            os.fsync(f.fileno())
+    except Exception:
+        pass
+
+
 def _watchdog(budget_s):
     deadline = _T0 + budget_s
     while True:
@@ -564,6 +635,19 @@ def _watchdog(budget_s):
             return
         if now >= deadline:
             _log(f"watchdog fired in phase {_STATE['phase']!r}")
+            # forensics BEFORE the JSON line: an armed blackbox dumps
+            # all-thread stacks + span tree into the sidecar/artifact,
+            # and the report artifact is flushed+fsynced — the timeout
+            # line is the LAST thing this process says, never the only
+            try:
+                from boojum_tpu.utils import blackbox as _bb
+
+                bb = _bb.current_blackbox()
+                if bb is not None:
+                    bb.dump("watchdog", budget_s=budget_s)
+            except Exception:
+                pass
+            _flush_report_artifact()
             _emit("timeout")
             sys.stdout.flush()
             sys.stderr.flush()
@@ -671,6 +755,17 @@ def _is_transient(exc) -> bool:
 def main():
     budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     threading.Thread(target=_watchdog, args=(budget,), daemon=True).start()
+    # black-box recorder (ISSUE 15): with BOOJUM_TPU_BLACKBOX /
+    # BOOJUM_TPU_STALL_S armed, a heartbeat thread stamps a crash-safe
+    # sidecar (phase, open span, compile deltas, rss) and stall /
+    # deadline / SIGTERM dumps land in the report artifact — the layer
+    # that turns the next rc=124 into a stack trace
+    try:
+        from boojum_tpu.utils import blackbox as _bb
+
+        _bb.ensure_started(label="bench")
+    except Exception as e:
+        _log(f"blackbox failed to start: {e!r}")
 
     from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
     from boojum_tpu.utils.profiling import collect_stages, stop_collecting_stages
@@ -709,7 +804,7 @@ def main():
         pow_bits=0,
         fri_final_degree=16,
     )
-    _STATE["phase"] = "synthesis"
+    _set_phase("synthesis")
     if circuit == "sha256":
         num_bytes = int(os.environ.get("BENCH_SHA_BYTES", "8192"))
         cs = build_sha256(num_bytes)
@@ -736,7 +831,7 @@ def main():
         # under BOOJUM_TPU_AOT_DIR (default ./aot_artifacts), emit the
         # ledger line and exit — after this, a cold process proves with
         # zero XLA compiles (see BASELINE.md "AOT artifact protocol")
-        _STATE["phase"] = "build_artifacts"
+        _set_phase("build_artifacts")
         from boojum_tpu.prover import aot as _aot
 
         out_root = _aot.aot_dir() or os.path.join(
@@ -768,7 +863,7 @@ def main():
         # each kernel's ledger entry carries aot_hit, so the warm-up
         # wall on this run's JSON line is attributed to deserialization
         # rather than compilation
-        _STATE["phase"] = "aot_load"
+        _set_phase("aot_load")
         from boojum_tpu.prover import aot as _aot
 
         try:
@@ -791,7 +886,7 @@ def main():
     if (precompile_only or not no_precompile) and not aot_warmed:
         # overlap the remote compile round-trips BEFORE the first dispatch
         # pays them serially; everything lands in the persistent cache
-        _STATE["phase"] = "precompile"
+        _set_phase("precompile")
         workers = int(os.environ.get("BENCH_PRECOMPILE_WORKERS", "8"))
         _log(f"parallel precompile of the kernel library ({workers} workers)")
         try:
@@ -816,16 +911,17 @@ def main():
         _emit("precompile_only")
         return
 
-    _STATE["phase"] = "setup"
+    _set_phase("setup")
     _log("generating setup (compiles on a cold cache)")
-    setup = generate_setup(asm, config)
+    with _phase_deadline("setup", "BENCH_SETUP_DEADLINE_S", 300.0):
+        setup = generate_setup(asm, config)
 
     # warm-up (compiles) then timed runs; report the MEDIAN rep and its
     # per-stage wall-clock split (the tunnel-attached device is noisy, so a
     # single rep is not a number of record). The stage sink runs from the
     # warm-up on, so every emitted line — including a watchdog line fired
     # mid-warm-up — carries a stage split (schema 2).
-    _STATE["phase"] = "warmup_prove"
+    _set_phase("warmup_prove")
     _log("warm-up prove (compiles on a cold cache)")
     for attempt in (1, 2):
         sink = collect_stages()
@@ -833,9 +929,12 @@ def main():
         t0 = time.perf_counter()  # per-attempt: a failed attempt's stall
         # must not inflate the reported warm wall
         try:
-            proof = _prove_recorded(
-                "warmup", lambda: prove(asm, setup, config)
-            )
+            with _phase_deadline(
+                "warmup_prove", "BENCH_WARMUP_DEADLINE_S", 600.0
+            ):
+                proof = _prove_recorded(
+                    "warmup", lambda: prove(asm, setup, config)
+                )
             break
         except Exception as e:
             # the tunnel occasionally drops a big compile RPC; one retry
@@ -849,7 +948,7 @@ def main():
         if not _STATE["done"]:
             _STATE["stages"] = {name: round(dt, 3) for name, dt in sink}
     _log(f"warm-up prove done in {_STATE['warm_wall']}s; verifying")
-    _STATE["phase"] = "verify"
+    _set_phase("verify")
     assert verify(setup.vk, proof, asm.gates)
 
     if "--service" in sys.argv:
@@ -859,7 +958,7 @@ def main():
         # BENCH rounds need once single-proof wall stops being the
         # bottleneck. The warm-up prove above already validated parity
         # and warmed the caches the service will hit.
-        _STATE["phase"] = "service_drain"
+        _set_phase("service_drain")
         from boojum_tpu.service import ProvingService, ServiceConfig
 
         scfg = ServiceConfig.from_env()
@@ -946,20 +1045,21 @@ def main():
                 _STATE["service"] = summary
         stop_collecting_stages()
         if not os.environ.get("BENCH_SKIP_NTT"):
-            _STATE["phase"] = "ntt_metric"
+            _set_phase("ntt_metric")
             _measure_ntt()
         _emit("ok")
         return
 
-    _STATE["phase"] = "timed_reps"
+    _set_phase("timed_reps")
     rep_stages = []
     for i in range(reps):
         sink = collect_stages()
         _LIVE_SINK["sink"] = sink
         t0 = time.perf_counter()
-        proof = _prove_recorded(
-            f"rep{i + 1}", lambda: prove(asm, setup, config)
-        )
+        with _phase_deadline(f"rep{i + 1}", "BENCH_REP_DEADLINE_S", 60.0):
+            proof = _prove_recorded(
+                f"rep{i + 1}", lambda: prove(asm, setup, config)
+            )
         rep_wall = time.perf_counter() - t0
         rep_stages.append({name: round(dt, 3) for name, dt in sink})
         # update reps + the matching median split atomically wrt the
@@ -974,7 +1074,7 @@ def main():
     stop_collecting_stages()
 
     if not os.environ.get("BENCH_SKIP_NTT"):
-        _STATE["phase"] = "ntt_metric"
+        _set_phase("ntt_metric")
         _measure_ntt()
     _emit("ok")
 

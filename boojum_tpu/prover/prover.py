@@ -1048,11 +1048,17 @@ def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     capture)."""
     import os
 
+    from ..utils import blackbox as _blackbox
     from ..utils import profiling as _prof
     from ..utils import report as _report
 
     label = f"prove_n{assembly.trace_len}"
     path = os.environ.get("BOOJUM_TPU_REPORT")
+    # black-box forensics (utils/blackbox.py): with BOOJUM_TPU_BLACKBOX
+    # or BOOJUM_TPU_STALL_S armed, a heartbeat thread stamps a crash-safe
+    # sidecar and a stall/SIGTERM dump lands in the report artifact
+    _blackbox.ensure_started(label=label, report_path=path)
+    _blackbox.set_phase(label)
     with _prof.maybe_trace_capture(label) as trace_dir:
         if trace_dir:
             # attribute the capture to whoever is recording this prove

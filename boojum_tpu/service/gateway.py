@@ -230,6 +230,18 @@ class Gateway:
         # sampler only — no standalone plane even when the service
         # config carries a metrics_port: /metrics rides THIS server
         self.service.start_telemetry(sampler_only=True)
+        # black-box forensics (ISSUE 15): a gateway whose worker wedges
+        # mid-request leaves heartbeat + stack-dump forensics behind
+        try:
+            from ..utils import blackbox as _blackbox
+
+            _blackbox.ensure_started(
+                label="gateway",
+                report_path=self.service.report_path,
+            )
+            _blackbox.set_phase("gateway")
+        except Exception:
+            pass
         self._stop_worker.clear()
         self._worker = threading.Thread(
             target=self._worker_main, name="boojum-gateway-worker",

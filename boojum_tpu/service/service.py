@@ -315,6 +315,19 @@ class ProvingService:
         owns_sampler = not self.sampler.running()
         had_plane = self.metrics_plane is not None
         self.start_telemetry(self.config.metrics_port)
+        # black-box forensics (ISSUE 15): with BOOJUM_TPU_BLACKBOX /
+        # BOOJUM_TPU_STALL_S armed, a wedged worker loop dumps
+        # all-thread stacks into the report artifact instead of idling
+        # silently until the pod is recycled
+        try:
+            from ..utils import blackbox as _blackbox
+
+            _blackbox.ensure_started(
+                label="service_worker", report_path=self.report_path
+            )
+            _blackbox.set_phase("service_worker")
+        except Exception:
+            pass
         t0 = time.perf_counter()
         try:
             while stop is None or not stop.is_set():

@@ -1,0 +1,579 @@
+"""Black-box flight-data recorder — forensics for hangs and kills.
+
+The flight recorder (spans/report) answers "what did this prove do"
+AFTER it finishes; the telemetry sampler answers "what is the process
+doing" WHILE it runs. Neither survives the failure modes that actually
+cost pod time: bench rounds r03/r04 died rc=124 inside `warmup_prove`
+with nothing but a phase label to show for 1500 s, because everything
+interesting was buffered in memory when `timeout -k` delivered SIGKILL.
+
+This module is the black box that survives the crash:
+
+- a heartbeat daemon thread stamps (phase, innermost open span,
+  compile-ledger deltas, rss / device memory, monotonic progress
+  counter) into a crash-safe append-only JSONL sidecar — every line is
+  flushed AND fsynced before the next beat, so the sidecar is valid up
+  to the last instant no matter how the process dies;
+- a stall detector fires when the progress counter freezes for
+  `BOOJUM_TPU_STALL_S` seconds and dumps all-thread Python stacks
+  (faulthandler + `sys._current_frames`) plus the partial span tree
+  into the sidecar and the `BOOJUM_TPU_REPORT` artifact;
+- SIGTERM/SIGINT handlers produce the same dump before the process
+  dies, so an external `timeout -k` kill still leaves forensics;
+- per-phase deadline alarms (`bb.deadline("setup", 300)`) give a
+  localized dump when one phase blows its budget instead of a silent
+  global watchdog line.
+
+Progress is a plain module-level int bumped by `tick()` from span
+open (utils/spans.py) and Fiat–Shamir checkpoints (utils/report.py):
+any Python-level forward motion resets the stall clock, so only a
+genuinely wedged process (or one long device computation past the
+stall budget — which is exactly what you want localized) trips it.
+
+Enablement rides `BOOJUM_TPU_BLACKBOX` (truthy, or a sidecar path) or
+`BOOJUM_TPU_STALL_S` (seconds); cadence rides
+`BOOJUM_TPU_BLACKBOX_INTERVAL` (default 5 s). The module-level
+current-blackbox slot follows the same install/current pattern as the
+other collectors — a single immutable reference, swapped whole.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import faulthandler
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import traceback
+
+from . import profiling as _prof
+from . import spans as _spans
+from . import telemetry as _telemetry
+
+BLACKBOX_KIND = "boojum_tpu.blackbox"
+BLACKBOX_SCHEMA = 1
+DEFAULT_INTERVAL_S = 5.0
+# heartbeats replayed inside a dump record — the trail that shows what
+# the process was doing in the minute before it wedged
+DUMP_HEARTBEATS = 12
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("", "0", "false", "off", "no")
+
+# monotonic progress counter — a plain int (GIL-atomic enough: the
+# stall detector only needs changed-or-not, never an exact count)
+_PROGRESS = 0
+
+
+def tick(n: int = 1) -> int:
+    """Bump the process-wide progress counter. Called from span open
+    and checkpoint(); any call resets the stall clock."""
+    global _PROGRESS
+    _PROGRESS += n
+    return _PROGRESS
+
+
+def progress() -> int:
+    return _PROGRESS
+
+
+def blackbox_interval_s() -> float:
+    """BOOJUM_TPU_BLACKBOX_INTERVAL: heartbeat cadence in seconds
+    (default 5.0; must be > 0)."""
+    v = os.environ.get("BOOJUM_TPU_BLACKBOX_INTERVAL", "").strip()
+    if not v:
+        return DEFAULT_INTERVAL_S
+    iv = float(v)
+    if iv <= 0:
+        raise ValueError(
+            f"BOOJUM_TPU_BLACKBOX_INTERVAL={v!r}: must be > 0 seconds"
+        )
+    return iv
+
+
+def stall_timeout_s() -> float | None:
+    """BOOJUM_TPU_STALL_S: seconds of frozen progress before a stall
+    dump fires (None = stall detection off)."""
+    v = os.environ.get("BOOJUM_TPU_STALL_S", "").strip()
+    if not v:
+        return None
+    sv = float(v)
+    if sv <= 0:
+        raise ValueError(f"BOOJUM_TPU_STALL_S={v!r}: must be > 0 seconds")
+    return sv
+
+
+def blackbox_enabled() -> bool:
+    """The recorder arms when BOOJUM_TPU_BLACKBOX is truthy (or names a
+    sidecar path) or when a stall budget is set."""
+    v = os.environ.get("BOOJUM_TPU_BLACKBOX", "").strip()
+    if v.lower() in _FALSY:
+        return bool(os.environ.get("BOOJUM_TPU_STALL_S", "").strip())
+    return True
+
+
+def _sidecar_from_env() -> str | None:
+    """A non-boolean BOOJUM_TPU_BLACKBOX value is the sidecar path;
+    otherwise derive `<report>.blackbox` from BOOJUM_TPU_REPORT."""
+    v = os.environ.get("BOOJUM_TPU_BLACKBOX", "").strip()
+    if v and v.lower() not in _TRUTHY and v.lower() not in _FALSY:
+        return v
+    report = os.environ.get("BOOJUM_TPU_REPORT", "").strip()
+    if report:
+        return report + ".blackbox"
+    return None
+
+
+def _rss_kb() -> int | None:
+    """Current RSS in KiB via /proc/self/statm (Linux); None elsewhere."""
+    try:
+        with open("/proc/self/statm") as f:
+            fields = f.read().split()
+        return int(fields[1]) * (os.sysconf("SC_PAGE_SIZE") // 1024)
+    except Exception:
+        return None
+
+
+def _open_span_path(rec) -> str | None:
+    """The innermost still-open span of `rec`, as a /-joined path
+    ("prove/round3_quotient"). Reads the sanitized tree() snapshot —
+    open spans surface there with error="unclosed" — so the heartbeat
+    thread never touches the recorder's thread-local stack."""
+    if rec is None:
+        return None
+    try:
+        roots = rec.tree()
+    except Exception:
+        return None
+    best: list[str] | None = None
+
+    def _walk(sp, path):
+        nonlocal best
+        path = path + [sp.get("name", "?")]
+        open_here = sp.get("error") == "unclosed"
+        deeper = False
+        for c in sp.get("children", ()):
+            if _walk(c, path):
+                deeper = True
+        if open_here and not deeper:
+            if best is None or len(path) > len(best):
+                best = path
+        return open_here or deeper
+
+    for r in roots:
+        _walk(r, [])
+    return "/".join(best) if best else None
+
+
+def _ledger_fields() -> dict:
+    """A small cumulative slice of the compile ledger — the heartbeat
+    diffs consecutive beats into `*_delta` fields so a beat stream
+    shows WHEN compilation happened, not just that it did."""
+    led = _prof.current_compile_ledger()
+    if led is None:
+        return {}
+    try:
+        s = led.summary()
+    except Exception:
+        return {}
+    out = {}
+    for k in (
+        "num_kernels",
+        "cache_hits",
+        "cache_misses",
+        "num_dispatch_compiles",
+        "aot_hits",
+        "aot_misses",
+    ):
+        if k in s:
+            out[f"compile.{k}"] = s[k]
+    return out
+
+
+def _thread_stacks() -> list[dict]:
+    """Structured per-thread stacks via sys._current_frames — the
+    machine-readable complement of the faulthandler text."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in sys._current_frames().items():
+        out.append(
+            {
+                "thread": names.get(ident, str(ident)),
+                "stack": [
+                    ln.rstrip()
+                    for ln in traceback.format_stack(frame)[-12:]
+                ],
+            }
+        )
+    return out
+
+
+def _faulthandler_text() -> str:
+    """All-thread dump as faulthandler renders it. faulthandler writes
+    only to real fds, so dump into a temp file and read it back."""
+    try:
+        with tempfile.TemporaryFile(mode="w+") as f:
+            faulthandler.dump_traceback(file=f, all_threads=True)
+            f.seek(0)
+            return f.read()
+    except Exception as e:
+        return f"<faulthandler unavailable: {type(e).__name__}: {e}>"
+
+
+class BlackBox:
+    """One armed recorder: a heartbeat thread + stall/deadline/signal
+    dump machinery over one append-only sidecar file."""
+
+    def __init__(
+        self,
+        sidecar: str | None = None,
+        interval_s: float | None = None,
+        stall_s: float | None = None,
+        label: str = "",
+        report_path: str | None = None,
+    ):
+        self.sidecar = sidecar if sidecar is not None else _sidecar_from_env()
+        self.interval_s = (
+            blackbox_interval_s() if interval_s is None else float(interval_s)
+        )
+        if self.interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.stall_s = stall_timeout_s() if stall_s is None else stall_s
+        self.label = label
+        self.report_path = report_path
+        self.t0 = time.perf_counter()
+        self._phase: str = ""
+        self._seq = 0
+        self._heartbeats: collections.deque = collections.deque(
+            maxlen=DUMP_HEARTBEATS
+        )
+        self._deadlines: dict[int, tuple[str, float]] = {}
+        self._deadline_fired: set[int] = set()
+        self._deadline_seq = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._fd = None
+        self._last_progress = progress()
+        self._last_change_t = time.perf_counter()
+        self._stall_dumped = False
+        self._ledger_prev: dict = {}
+        self._prev_handlers: dict[int, object] = {}
+        self._in_signal_dump = False
+        self.dumps = 0
+
+    # ---- phase / deadlines ----------------------------------------------
+    def set_phase(self, phase: str):
+        self._phase = phase
+        tick()
+
+    @property
+    def phase(self) -> str:
+        return self._phase
+
+    @contextlib.contextmanager
+    def deadline(self, name: str, seconds: float):
+        """Declare "this block may take `seconds`": if it is still open
+        when the budget expires, the heartbeat thread emits one
+        localized dump (reason="deadline") naming the block."""
+        with self._lock:
+            self._deadline_seq += 1
+            did = self._deadline_seq
+            self._deadlines[did] = (
+                name,
+                time.perf_counter() + float(seconds),
+            )
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._deadlines.pop(did, None)
+                self._deadline_fired.discard(did)
+
+    # ---- sidecar IO -------------------------------------------------------
+    def _write_sidecar(self, rec: dict):
+        if self.sidecar is None:
+            return
+        with self._lock:
+            if self._fd is None:
+                self._fd = open(self.sidecar, "a")
+            self._fd.write(json.dumps(rec, sort_keys=True) + "\n")
+            self._fd.flush()
+            os.fsync(self._fd.fileno())
+
+    def _write_report(self, rec: dict):
+        """Append a dump into the ProveReport artifact (crash-safely:
+        open/append/flush/fsync/close) so `prove_report.py --check`
+        sees the forensics next to the prove lines."""
+        path = self.report_path or os.environ.get("BOOJUM_TPU_REPORT")
+        if not path:
+            return
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except Exception:
+            pass
+
+    # ---- records ----------------------------------------------------------
+    def _base_record(self, record: str) -> dict:
+        self._seq += 1
+        rec: dict = {
+            "kind": BLACKBOX_KIND,
+            "schema": BLACKBOX_SCHEMA,
+            "record": record,
+            "seq": self._seq,
+            "t_s": round(time.perf_counter() - self.t0, 3),
+            "unix_ts": time.time(),
+            "pid": os.getpid(),
+            "phase": self._phase,
+            "progress": progress(),
+        }
+        if self.label:
+            rec["label"] = self.label
+        sp = _open_span_path(_spans.current_recorder())
+        if sp is not None:
+            rec["span"] = sp
+        return rec
+
+    def heartbeat(self) -> dict:
+        """Stamp one beat into the sidecar (flushed + fsynced)."""
+        rec = self._base_record("heartbeat")
+        rss = _rss_kb()
+        if rss is not None:
+            rec["rss_kb"] = rss
+        sampler = _telemetry.current_sampler()
+        if sampler is not None:
+            latest = sampler.latest()
+            if latest:
+                for k in ("device_bytes_in_use", "live_bytes"):
+                    if k in latest:
+                        rec[k] = latest[k]
+        led = _ledger_fields()
+        for k, v in led.items():
+            rec[k] = v
+            prev = self._ledger_prev.get(k)
+            if prev is not None and v != prev:
+                rec[f"{k}_delta"] = v - prev
+        self._ledger_prev = led
+        self._heartbeats.append(rec)
+        self._write_sidecar(rec)
+        return rec
+
+    def dump(self, reason: str, **extra) -> dict:
+        """The forensic record: all-thread stacks + partial span tree +
+        the recent heartbeat trail, written to the sidecar AND the
+        report artifact, both fsynced."""
+        rec = self._base_record("dump")
+        rec["reason"] = reason
+        rec.update(extra)
+        rec["stacks"] = _thread_stacks()
+        rec["faulthandler"] = _faulthandler_text()
+        srec = _spans.current_recorder()
+        if srec is not None:
+            try:
+                rec["spans"] = srec.tree()
+            except Exception:
+                pass
+        rec["heartbeats"] = list(self._heartbeats)
+        self.dumps += 1
+        self._write_sidecar(rec)
+        self._write_report(rec)
+        try:
+            where = f" in {rec['span']}" if rec.get("span") else ""
+            print(
+                f"[boojum-tpu] blackbox dump: reason={reason}"
+                f" phase={self._phase or '?'}{where}"
+                f" progress={rec['progress']}",
+                file=sys.stderr,
+                flush=True,
+            )
+        except Exception:
+            pass
+        return rec
+
+    # ---- monitor loop -----------------------------------------------------
+    def _check_stall(self, now: float):
+        cur = progress()
+        if cur != self._last_progress:
+            self._last_progress = cur
+            self._last_change_t = now
+            self._stall_dumped = False
+            return
+        if (
+            self.stall_s is not None
+            and not self._stall_dumped
+            and now - self._last_change_t >= self.stall_s
+        ):
+            self._stall_dumped = True
+            self.dump(
+                "stall",
+                stall_s=self.stall_s,
+                frozen_for_s=round(now - self._last_change_t, 3),
+            )
+
+    def _check_deadlines(self, now: float):
+        with self._lock:
+            expired = [
+                (did, name, ts)
+                for did, (name, ts) in self._deadlines.items()
+                if now >= ts and did not in self._deadline_fired
+            ]
+            for did, _, _ in expired:
+                self._deadline_fired.add(did)
+        for _, name, ts in expired:
+            self.dump(
+                "deadline",
+                deadline=name,
+                overdue_s=round(now - ts, 3),
+            )
+
+    def _run(self):
+        # sub-second poll when the stall/deadline budgets are tighter
+        # than the heartbeat cadence, so a 0.2 s test budget fires fast
+        poll = self.interval_s
+        if self.stall_s is not None:
+            poll = min(poll, max(self.stall_s / 4.0, 0.05))
+        next_beat = 0.0
+        while not self._stop.wait(poll):
+            now = time.perf_counter()
+            try:
+                self._check_stall(now)
+                self._check_deadlines(now)
+                if now >= next_beat:
+                    next_beat = now + self.interval_s
+                    self.heartbeat()
+            except Exception:
+                # forensics must never take the workload down
+                continue
+
+    # ---- signals ----------------------------------------------------------
+    def _signal_dump(self, signum, frame):
+        if not self._in_signal_dump:
+            self._in_signal_dump = True
+            try:
+                name = signal.Signals(signum).name.lower()
+            except Exception:
+                name = str(signum)
+            try:
+                self.dump(name, signum=int(signum))
+            except Exception:
+                pass
+        prev = self._prev_handlers.get(signum, signal.SIG_DFL)
+        if callable(prev):
+            prev(signum, frame)
+        elif prev == signal.SIG_DFL:
+            # re-deliver with the default disposition so the exit
+            # status still says "killed by SIGTERM" to the parent
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+    def _install_signals(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                self._prev_handlers[sig] = signal.signal(
+                    sig, self._signal_dump
+                )
+            except (ValueError, OSError):
+                pass
+
+    def _restore_signals(self):
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for sig, prev in list(self._prev_handlers.items()):
+            try:
+                if signal.getsignal(sig) == self._signal_dump:
+                    signal.signal(sig, prev)
+            except (ValueError, OSError, TypeError):
+                pass
+        self._prev_handlers.clear()
+
+    # ---- lifecycle --------------------------------------------------------
+    def start(self) -> "BlackBox":
+        self._stop.clear()
+        t = self._thread
+        if t is not None and t.is_alive():
+            return self
+        self._install_signals()
+        self._last_progress = progress()
+        self._last_change_t = time.perf_counter()
+        self.heartbeat()  # one synchronous baseline beat
+        self._thread = threading.Thread(
+            target=self._run, name="boojum-blackbox", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2 * self.interval_s + 1.0)
+            if t.is_alive():
+                return
+        self._thread = None
+        self._restore_signals()
+        with self._lock:
+            if self._fd is not None:
+                try:
+                    self._fd.close()
+                except Exception:
+                    pass
+                self._fd = None
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+
+# process-wide current-blackbox slot — immutable None or a BlackBox
+# reference, same install/current pattern as the other collectors
+_BLACKBOX: BlackBox | None = None
+
+
+def current_blackbox() -> BlackBox | None:
+    return _BLACKBOX
+
+
+def install_blackbox(bb: BlackBox | None) -> BlackBox | None:
+    """Swap the process-wide blackbox slot; returns the previous one.
+    The caller owns start()/stop()."""
+    global _BLACKBOX
+    prev = _BLACKBOX
+    _BLACKBOX = bb
+    return prev
+
+
+def ensure_started(
+    label: str = "", report_path: str | None = None
+) -> BlackBox | None:
+    """Entry-point wiring: arm (and start) a process-wide blackbox when
+    the env asks for one and none is installed yet. Idempotent — the
+    second entry point to run just updates the label/phase context via
+    set_phase. Returns the active blackbox (or None when disabled)."""
+    bb = _BLACKBOX
+    if bb is not None:
+        if not bb.running():
+            bb.start()
+        return bb
+    if not blackbox_enabled():
+        return None
+    bb = BlackBox(label=label, report_path=report_path)
+    install_blackbox(bb)
+    bb.start()
+    return bb
+
+
+def set_phase(phase: str):
+    """Stamp the current coarse phase onto the active blackbox (no-op
+    when none is armed); also a progress tick."""
+    bb = _BLACKBOX
+    if bb is not None:
+        bb.set_phase(phase)

@@ -29,6 +29,7 @@ import contextvars
 import hashlib
 import json
 import os
+import re
 import time
 
 REPORT_KIND = "boojum_tpu.prove_report"
@@ -41,6 +42,15 @@ REPORT_KIND = "boojum_tpu.prove_report"
 # older-schema lines remain valid for --check/--diff
 REPORT_SCHEMA = 3
 ACCEPTED_SCHEMAS = (1, 2, 3)
+
+# black-box forensics records (utils/blackbox.py): heartbeat/dump lines
+# interleave with prove lines in the same JSONL artifact; fleet records
+# are what `prove_report.py --fleet` emits from per-host artifacts.
+# --check routes every line by kind (validate_line)
+BLACKBOX_KIND = "boojum_tpu.blackbox"
+BLACKBOX_SCHEMAS = (1,)
+FLEET_KIND = "boojum_tpu.fleet"
+FLEET_SCHEMAS = (1,)
 
 # canonical Fiat–Shamir round order; validation checks checkpoint rounds
 # never decrease along the stream
@@ -130,6 +140,12 @@ def checkpoint(round_: int, label: str, values):
     log = current_checkpoint_log()
     if log is not None:
         log.add(round_, label, values)
+        # a new transcript digest is forward motion — reset the
+        # blackbox stall clock (utils/blackbox.py); only on the
+        # recording path, the no-op path stays two reads
+        from . import blackbox as _bb
+
+        _bb.tick()
 
 
 # ---------------------------------------------------------------------------
@@ -843,6 +859,420 @@ def _validate_telemetry(telemetry) -> list[str]:
     return problems
 
 
+# ---------------------------------------------------------------------------
+# Black-box forensics records (utils/blackbox.py) + fleet aggregation
+# ---------------------------------------------------------------------------
+
+
+def validate_blackbox(rec: dict) -> list[str]:
+    """--check gate for one blackbox heartbeat/dump line. The bar the
+    forensics must clear to be trusted during an incident: monotonic
+    seq, sane timestamps, and — for dumps — actual stacks plus a
+    machine-usable reason, so a stall dump that lost its payload fails
+    loudly instead of reading as 'no problem found'."""
+    problems: list[str] = []
+    if rec.get("kind") != BLACKBOX_KIND:
+        problems.append(
+            f"kind is {rec.get('kind')!r}, want {BLACKBOX_KIND!r}"
+        )
+    if rec.get("schema") not in BLACKBOX_SCHEMAS:
+        problems.append(
+            f"schema is {rec.get('schema')!r}, want one of "
+            f"{BLACKBOX_SCHEMAS}"
+        )
+    record = rec.get("record")
+    if record not in ("heartbeat", "dump"):
+        problems.append(f"record invalid: {record!r}")
+    seq = rec.get("seq")
+    if not isinstance(seq, int) or seq < 1:
+        problems.append(f"seq invalid: {seq!r}")
+    for k in ("t_s", "unix_ts"):
+        v = rec.get(k)
+        if not isinstance(v, (int, float)) or v != v or v < 0:
+            problems.append(f"{k} invalid: {v!r}")
+    prog = rec.get("progress")
+    if not isinstance(prog, int) or prog < 0:
+        problems.append(f"progress invalid: {prog!r}")
+    if not isinstance(rec.get("phase"), str):
+        problems.append(f"phase invalid: {rec.get('phase')!r}")
+    if "span" in rec and not (
+        isinstance(rec["span"], str) and rec["span"]
+    ):
+        problems.append(f"span invalid: {rec['span']!r}")
+    if record != "dump":
+        return problems
+    reason = rec.get("reason")
+    if not (isinstance(reason, str) and reason):
+        problems.append(f"dump reason invalid: {reason!r}")
+    if reason == "stall":
+        ss = rec.get("stall_s")
+        if not isinstance(ss, (int, float)) or ss <= 0:
+            problems.append(f"stall dump: stall_s invalid: {ss!r}")
+    if reason == "deadline" and not rec.get("deadline"):
+        problems.append("deadline dump: deadline name missing")
+    stacks = rec.get("stacks")
+    if not isinstance(stacks, list) or not stacks:
+        problems.append("dump stacks missing/empty")
+    else:
+        for i, st in enumerate(stacks):
+            if not (
+                isinstance(st, dict)
+                and isinstance(st.get("thread"), str)
+                and isinstance(st.get("stack"), list)
+                and st["stack"]
+            ):
+                problems.append(f"dump stack {i} malformed")
+    if not isinstance(rec.get("faulthandler"), str):
+        problems.append("dump faulthandler text missing")
+    hbs = rec.get("heartbeats")
+    if not isinstance(hbs, list):
+        problems.append("dump heartbeat trail missing")
+    else:
+        for i, hb in enumerate(hbs):
+            if not (
+                isinstance(hb, dict) and hb.get("record") == "heartbeat"
+            ):
+                problems.append(f"dump heartbeat {i} malformed")
+    if "spans" in rec and not isinstance(rec["spans"], list):
+        problems.append("dump spans malformed")
+    return problems
+
+
+def validate_fleet(rec: dict) -> list[str]:
+    """--check gate for a fleet record (`prove_report.py --fleet`
+    output): host entries named and unique, stage stats internally
+    consistent (max >= median, max_host a real host), stragglers
+    referring to real stages/hosts."""
+    problems: list[str] = []
+    if rec.get("kind") != FLEET_KIND:
+        problems.append(f"kind is {rec.get('kind')!r}, want {FLEET_KIND!r}")
+    if rec.get("schema") not in FLEET_SCHEMAS:
+        problems.append(
+            f"schema is {rec.get('schema')!r}, want one of {FLEET_SCHEMAS}"
+        )
+    hosts = rec.get("hosts")
+    if not isinstance(hosts, list) or not hosts:
+        return problems + ["hosts missing/empty"]
+    names = []
+    for i, h in enumerate(hosts):
+        if not isinstance(h, dict) or not h.get("host"):
+            problems.append(f"host {i}: entry malformed")
+            continue
+        names.append(h["host"])
+        off = h.get("clock_offset_s")
+        if off is not None and (
+            not isinstance(off, (int, float)) or off != off or off < 0
+        ):
+            problems.append(f"host {h['host']}: clock_offset_s invalid: {off!r}")
+        stages = h.get("stages")
+        if stages is not None and not isinstance(stages, dict):
+            problems.append(f"host {h['host']}: stages malformed")
+        for k in ("ici_bytes", "transfer_bytes", "wall_s"):
+            v = h.get(k)
+            if v is not None and (
+                not isinstance(v, (int, float)) or v != v or v < 0
+            ):
+                problems.append(f"host {h['host']}: {k} invalid: {v!r}")
+    if len(set(names)) != len(names):
+        problems.append(f"duplicate host names: {names}")
+    n = rec.get("n_hosts")
+    if n != len(hosts):
+        problems.append(f"n_hosts {n!r} != len(hosts) {len(hosts)}")
+    stages = rec.get("stages")
+    if not isinstance(stages, dict):
+        problems.append("stages missing")
+        stages = {}
+    for nm, st in stages.items():
+        if not isinstance(st, dict):
+            problems.append(f"stage {nm}: malformed")
+            continue
+        med, mx = st.get("median_s"), st.get("max_s")
+        if not isinstance(med, (int, float)) or med < 0:
+            problems.append(f"stage {nm}: median_s invalid: {med!r}")
+        if not isinstance(mx, (int, float)) or mx < 0:
+            problems.append(f"stage {nm}: max_s invalid: {mx!r}")
+        if (
+            isinstance(med, (int, float))
+            and isinstance(mx, (int, float))
+            and mx + 1e-9 < med
+        ):
+            problems.append(f"stage {nm}: max_s {mx} < median_s {med}")
+        if st.get("max_host") not in names:
+            problems.append(
+                f"stage {nm}: max_host {st.get('max_host')!r} not a host"
+            )
+        walls = st.get("walls")
+        if not isinstance(walls, dict):
+            problems.append(f"stage {nm}: walls missing")
+        else:
+            for hn in walls:
+                if hn not in names:
+                    problems.append(f"stage {nm}: wall host {hn!r} unknown")
+    for i, s in enumerate(rec.get("stragglers") or ()):
+        if not isinstance(s, dict):
+            problems.append(f"straggler {i}: malformed")
+            continue
+        if s.get("stage") not in stages:
+            problems.append(f"straggler {i}: stage {s.get('stage')!r} unknown")
+        if s.get("host") not in names:
+            problems.append(f"straggler {i}: host {s.get('host')!r} unknown")
+        r = s.get("ratio")
+        if not isinstance(r, (int, float)) or r < 1.0:
+            problems.append(f"straggler {i}: ratio invalid: {r!r}")
+    clock = rec.get("clock")
+    if not isinstance(clock, dict) or clock.get("method") not in (
+        "barrier",
+        "none",
+    ):
+        problems.append(f"clock malformed: {clock!r}")
+    return problems
+
+
+def validate_line(doc: dict) -> list[str]:
+    """Route one artifact line to its kind's validator — the --check
+    entry point now that blackbox dumps and fleet records interleave
+    with prove lines in the same JSONL files."""
+    kind = doc.get("kind")
+    if kind == BLACKBOX_KIND:
+        return validate_blackbox(doc)
+    if kind == FLEET_KIND:
+        return validate_fleet(doc)
+    return validate_report(doc)
+
+
+def _sum_gauges(metrics: dict, prefixes: tuple, contains: str) -> float | None:
+    total = 0.0
+    found = False
+    for k, v in (metrics.get("gauges") or {}).items():
+        if contains in k and any(k.startswith(p) for p in prefixes):
+            if isinstance(v, (int, float)):
+                total += float(v)
+                found = True
+    return total if found else None
+
+
+def _fleet_host_entry(label: str, docs: list[dict]) -> dict:
+    """Distill one host's artifact lines (multihost result line and/or
+    per-host ProveReport JSONL and/or blackbox records) into one fleet
+    host entry."""
+    entry: dict = {"host": label}
+    dumps = 0
+    for d in docs:
+        if not isinstance(d, dict):
+            continue
+        kind = d.get("kind")
+        if kind == BLACKBOX_KIND:
+            if d.get("record") == "dump":
+                dumps += 1
+            if d.get("phase"):
+                entry["phase"] = d["phase"]
+            continue
+        if kind == REPORT_KIND:
+            spans = d.get("spans") or []
+            if any(
+                sp.get("name") == "prove" for _p, sp in _walk_spans(spans)
+            ):
+                walls = stage_walls(spans)
+                if walls:
+                    entry["stages"] = {
+                        k: round(v, 6) for k, v in walls.items()
+                    }
+                if isinstance(d.get("wall_s"), (int, float)):
+                    entry["wall_s"] = d["wall_s"]
+            m = d.get("metrics")
+            if isinstance(m, dict):
+                ici = _sum_gauges(m, ("ici.",), "bytes")
+                if ici is not None:
+                    entry["ici_bytes"] = entry.get("ici_bytes", 0.0) + ici
+                xfer = _sum_gauges(m, ("transfer.", "limb."), "bytes")
+                if xfer is not None:
+                    entry["transfer_bytes"] = (
+                        entry.get("transfer_bytes", 0.0) + xfer
+                    )
+            continue
+        # multihost_worker result line: {pid, proofs, ici, clock_sync}
+        if "pid" in d and ("proofs" in d or "clock_sync" in d or "ici" in d):
+            if isinstance(d.get("pid"), int):
+                entry["pid"] = d["pid"]
+            cs = d.get("clock_sync")
+            if isinstance(cs, dict) and isinstance(
+                cs.get("barrier_unix_ts"), (int, float)
+            ):
+                entry["barrier_unix_ts"] = cs["barrier_unix_ts"]
+            ici = d.get("ici")
+            if isinstance(ici, dict):
+                tot = sum(
+                    float(v)
+                    for k, v in ici.items()
+                    if "bytes" in k and isinstance(v, (int, float))
+                )
+                if tot:
+                    entry.setdefault("ici_bytes", tot)
+            rp = d.get("prove_report_path")
+            if isinstance(rp, str) and rp:
+                entry["prove_report_path"] = rp
+    if dumps:
+        entry["dumps"] = dumps
+    return entry
+
+
+def fleet_merge(
+    host_docs: list,
+    straggler_ratio: float = 1.5,
+    min_abs_s: float = 0.05,
+) -> dict:
+    """Merge per-host artifacts into ONE mesh-wide fleet record
+    (DIZK's lesson: cluster proving lives or dies on per-node straggler
+    attribution). `host_docs` is [(label, [parsed lines...]), ...] —
+    one element per host, typically a multihost_worker result file or
+    its per-host ProveReport.
+
+    Clock alignment: hosts that stamped a barrier-synchronized
+    `clock_sync.barrier_unix_ts` (scripts/multihost_worker.py) all
+    passed the same collective at the same instant, so the pairwise
+    differences of those stamps ARE the wall-clock skews — no NTP
+    assumption. Offsets are reported relative to the earliest host.
+
+    Straggler rule: a stage straggles when its slowest host exceeds
+    straggler_ratio x the across-host median AND by at least min_abs_s
+    (sub-50ms spread is scheduling jitter, not a straggler)."""
+    hosts = [_fleet_host_entry(lbl, docs) for lbl, docs in host_docs]
+    # clock skew from barrier stamps
+    stamps = {
+        h["host"]: h["barrier_unix_ts"]
+        for h in hosts
+        if isinstance(h.get("barrier_unix_ts"), (int, float))
+    }
+    if len(stamps) >= 2:
+        t0 = min(stamps.values())
+        for h in hosts:
+            if h["host"] in stamps:
+                h["clock_offset_s"] = round(stamps[h["host"]] - t0, 6)
+        clock = {
+            "method": "barrier",
+            "max_skew_s": round(max(stamps.values()) - t0, 6),
+        }
+    else:
+        clock = {
+            "method": "none",
+            "note": (
+                "fewer than 2 hosts carry clock_sync.barrier_unix_ts; "
+                "stage walls are durations (skew-free) but timelines "
+                "are unaligned"
+            ),
+        }
+    # per-stage across-host stats
+    stage_hosts: dict = {}
+    for h in hosts:
+        for nm, w in (h.get("stages") or {}).items():
+            if isinstance(w, (int, float)):
+                stage_hosts.setdefault(nm, {})[h["host"]] = float(w)
+    stages: dict = {}
+    stragglers: list = []
+    for nm in sorted(stage_hosts):
+        walls = stage_hosts[nm]
+        med = _percentile(sorted(walls.values()), 0.5)
+        max_host = max(walls, key=walls.get)
+        mx = walls[max_host]
+        stages[nm] = {
+            "median_s": round(med, 6),
+            "max_s": round(mx, 6),
+            "max_host": max_host,
+            "walls": {k: round(v, 6) for k, v in sorted(walls.items())},
+        }
+        if (
+            len(walls) >= 2
+            and med > 0
+            and mx > med * straggler_ratio
+            and (mx - med) >= min_abs_s
+        ):
+            stragglers.append(
+                {
+                    "stage": nm,
+                    "host": max_host,
+                    "wall_s": round(mx, 6),
+                    "median_s": round(med, 6),
+                    "ratio": round(mx / med, 4),
+                }
+            )
+    return {
+        "kind": FLEET_KIND,
+        "schema": FLEET_SCHEMAS[-1],
+        "unix_ts": time.time(),
+        "n_hosts": len(hosts),
+        "hosts": hosts,
+        "stages": stages,
+        "stragglers": stragglers,
+        "clock": clock,
+        "straggler_ratio": straggler_ratio,
+    }
+
+
+def render_fleet(rec: dict) -> str:
+    """Text view of a fleet record: host roster with clock offsets and
+    byte rollups, then the per-stage wall table (one column per host)
+    with stragglers flagged."""
+    lines = []
+    clock = rec.get("clock") or {}
+    skew = clock.get("max_skew_s")
+    lines.append(
+        f"fleet: {rec.get('n_hosts')} hosts, clock={clock.get('method')}"
+        + (f" (max skew {skew}s)" if skew is not None else "")
+    )
+    if clock.get("note"):
+        lines.append(f"  note: {clock['note']}")
+    hosts = rec.get("hosts") or []
+    lines.append(
+        f"  {'host':<16} {'offset_s':>9} {'wall_s':>9} "
+        f"{'ici_MB':>9} {'xfer_MB':>9} {'dumps':>6}"
+    )
+    for h in hosts:
+        def _mb(v):
+            return f"{v / 1e6:.2f}" if isinstance(v, (int, float)) else "-"
+
+        off = h.get("clock_offset_s")
+        wall = h.get("wall_s")
+        lines.append(
+            f"  {h.get('host', '?'):<16} "
+            f"{off if off is not None else '-':>9} "
+            f"{f'{wall:.3f}' if isinstance(wall, (int, float)) else '-':>9} "
+            f"{_mb(h.get('ici_bytes')):>9} "
+            f"{_mb(h.get('transfer_bytes')):>9} "
+            f"{h.get('dumps', 0):>6}"
+        )
+    stages = rec.get("stages") or {}
+    if stages:
+        names = [h.get("host", "?") for h in hosts]
+        header = "  " + f"{'stage':<26}" + "".join(
+            f"{n[:12]:>13}" for n in names
+        ) + f"{'median':>10}{'max':>10}"
+        lines.append("stage walls (s):")
+        lines.append(header)
+        flagged = {
+            (s["stage"], s["host"]) for s in rec.get("stragglers") or ()
+        }
+        for nm, st in stages.items():
+            cells = []
+            for n in names:
+                w = (st.get("walls") or {}).get(n)
+                cells.append(
+                    f"{w:.3f}" if isinstance(w, (int, float)) else "-"
+                )
+            row = f"  {nm:<26}" + "".join(f"{c:>13}" for c in cells)
+            row += f"{st.get('median_s'):>10}{st.get('max_s'):>10}"
+            if any((nm, n) in flagged for n in names):
+                row += "  << STRAGGLER"
+            lines.append(row)
+    for s in rec.get("stragglers") or ():
+        lines.append(
+            f"STRAGGLER: {s['stage']} on {s['host']}: {s['wall_s']}s "
+            f"vs median {s['median_s']}s (x{s['ratio']})"
+        )
+    if not rec.get("stragglers"):
+        lines.append("no stragglers")
+    return "\n".join(lines)
+
+
 def diff_reports(a: dict, b: dict, top: int = 10) -> dict:
     """Regression-triage diff: per-span wall deltas (matched by tree path,
     repeated paths summed) and the FIRST diverging digest checkpoint."""
@@ -1519,6 +1949,25 @@ def _point_values_from_bench(line: dict) -> dict:
     return values
 
 
+def _metric_line_from_tail(tail) -> dict | None:
+    """The LAST JSON metric line embedded in a wrapper's captured
+    stdout/stderr tail (bench.py emits exactly one; XLA noise around it
+    is skipped). None when the run died before emitting one."""
+    if not isinstance(tail, str) or not tail:
+        return None
+    for ln in reversed(tail.splitlines()):
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(d, dict) and "metric" in d:
+            return d
+    return None
+
+
 def load_trend_file(path: str) -> list[dict]:
     """Parse ONE artifact file into trend points (usually one point; a
     bench_micro line file yields one point carrying every metric).
@@ -1542,10 +1991,25 @@ def load_trend_file(path: str) -> list[dict]:
                 continue
     if not docs:
         return []
-    # BENCH round wrapper: {n, cmd, rc, parsed}
-    if len(docs) == 1 and isinstance(docs[0], dict) and "parsed" in docs[0]:
-        parsed = docs[0].get("parsed")
-        order = docs[0].get("n")
+    # round wrappers: BENCH {n, cmd, rc, parsed} and MULTICHIP
+    # {n_devices, rc, ok, tail}. MULTICHIP wrappers carry no `parsed`
+    # block (and no `n`): the metric line — when the run got far enough
+    # to emit one — is recovered from the captured `tail`, and the
+    # round number from the `_rNN` filename, so multi-host history
+    # rides the same ordered, identity-grouped series as BENCH rounds
+    if (
+        len(docs) == 1
+        and isinstance(docs[0], dict)
+        and ("parsed" in docs[0] or ("tail" in docs[0] and "rc" in docs[0]))
+    ):
+        wrapper = docs[0]
+        parsed = wrapper.get("parsed")
+        if not isinstance(parsed, dict):
+            parsed = _metric_line_from_tail(wrapper.get("tail"))
+        order = wrapper.get("n")
+        if not isinstance(order, (int, float)):
+            m = re.search(r"_r(\d+)", base)
+            order = int(m.group(1)) if m else None
         if not isinstance(parsed, dict):
             return []
         values = _point_values_from_bench(parsed)

@@ -199,6 +199,11 @@ def span(name: str, stage: bool = False, **attrs):
     observable surface). Exception-safe: a raising body still records the
     span, with an ``error`` field (ISSUE 2 satellite: the old stage_timer
     lost the timing line entirely)."""
+    from . import blackbox as _bb
+
+    # any span open is Python-level forward motion: reset the blackbox
+    # stall clock even on the cheap not-recording path
+    _bb.tick()
     rec = current_recorder()
     trace_dir = os.environ.get("BOOJUM_TPU_JAX_TRACE")
     if (

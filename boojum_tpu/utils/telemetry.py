@@ -198,6 +198,13 @@ class TelemetrySampler:
                 (s["t_s"], s[key]) for s in self._samples if key in s
             ]
 
+    def latest(self) -> dict:
+        """The most recent sample ({} before the first tick) — what the
+        blackbox heartbeat stamps for device-memory context without
+        touching the backend from its own thread."""
+        with self._lock:
+            return dict(self._samples[-1]) if self._samples else {}
+
 
 _SAMPLER: TelemetrySampler | None = None
 

@@ -1,0 +1,63 @@
+#!/usr/bin/env bash
+# ci_gate.sh — the one-command CI gate (ISSUE 15 satellite).
+#
+# Runs, in order:
+#   1. the tier-1 pytest invocation (ROADMAP.md — CPU backend, fast
+#      markers only), and
+#   2. the perf-trend regression gate over the checked-in BENCH_*.json
+#      and MULTICHIP_r*.json round history (scripts/prove_report.py
+#      --trend --gate: last point of every stage/metric series vs the
+#      median of its predecessors, 20% + 50 ms noise floor).
+#
+# Exits nonzero when either fails. Knobs:
+#   CI_GATE_TIMEOUT_S   tier-1 budget in seconds (default 870, as in
+#                       ROADMAP.md; the -k kill grace stays 10 s)
+#   CI_GATE_THRESHOLD   relative regression threshold (default 0.2)
+set -u -o pipefail
+
+root="$(cd "$(dirname "$0")/.." && pwd)"
+cd "$root"
+
+timeout_s="${CI_GATE_TIMEOUT_S:-870}"
+threshold="${CI_GATE_THRESHOLD:-0.2}"
+rc=0
+
+echo "== ci_gate: tier-1 tests (budget ${timeout_s}s) =="
+timeout -k 10 "$timeout_s" env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+t1_rc=$?
+if [ "$t1_rc" -ne 0 ]; then
+    echo "ci_gate: tier-1 tests FAILED (rc=$t1_rc)"
+    rc=1
+else
+    echo "ci_gate: tier-1 tests ok"
+fi
+
+echo "== ci_gate: perf trend gate =="
+# round history: BENCH wrappers + MULTICHIP wrappers (the trend loader
+# orders both by round number and groups by machine identity)
+history=()
+for f in BENCH_r*.json MULTICHIP_r*.json; do
+    [ -e "$f" ] && history+=("$f")
+done
+if [ "${#history[@]}" -eq 0 ]; then
+    echo "ci_gate: no BENCH_*/MULTICHIP_* history checked in; skipping gate"
+else
+    python scripts/prove_report.py --trend "${history[@]}" \
+        --gate --gate-threshold "$threshold"
+    gate_rc=$?
+    # rc=2 = no usable trend points (e.g. every wrapper predates the
+    # metric line) — nothing to gate is not a regression
+    if [ "$gate_rc" -eq 1 ]; then
+        echo "ci_gate: perf trend gate FAILED"
+        rc=1
+    elif [ "$gate_rc" -eq 2 ]; then
+        echo "ci_gate: no usable trend points; gate skipped"
+    else
+        echo "ci_gate: perf trend gate ok"
+    fi
+fi
+
+exit "$rc"

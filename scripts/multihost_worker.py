@@ -20,6 +20,11 @@ process), runs the requested DCN mode, and writes its result JSON. Modes:
             each proved job lands in the result line's "spool" dict.
   hybrid  — hybrid_mesh: one proof whose mesh 'col' axis spans both
             processes (GSPMD collectives cross the process boundary)
+
+Every result line carries a `clock_sync` record (ISSUE 15): time.time()
+stamped immediately after a global device barrier, so
+`prove_report.py --fleet` aligns per-host timelines from the stamps'
+pairwise differences instead of assuming NTP-synchronized clocks.
 """
 
 import json
@@ -103,11 +108,46 @@ def main():
     else:
         report_path = None
 
+    # black-box forensics (ISSUE 15): with BOOJUM_TPU_BLACKBOX /
+    # BOOJUM_TPU_STALL_S armed, a host wedged inside a cross-process
+    # collective leaves a heartbeat trail + stack dump behind — the
+    # per-host artifact `--fleet` aggregates
+    try:
+        from boojum_tpu.utils import blackbox as _blackbox
+
+        _blackbox.ensure_started(
+            label=f"multihost{pid}", report_path=report_path
+        )
+        _blackbox.set_phase(f"multihost_{mode}")
+    except Exception:
+        pass
+
+    # barrier-synchronized wall-clock stamp (ISSUE 15 satellite): every
+    # process reads time.time() immediately after passing the SAME
+    # global device barrier, so the pairwise differences of these stamps
+    # ARE the hosts' wall-clock skews — prove_report.py --fleet aligns
+    # per-host timelines from them without assuming NTP
+    clock_sync = None
+    try:
+        import time as _time
+
+        from jax.experimental import multihost_utils as _mhu
+
+        _mhu.sync_global_devices("boojum_tpu_clock_sync")
+        clock_sync = {
+            "barrier_unix_ts": _time.time(),
+            "method": "sync_global_devices",
+        }
+    except Exception as e:
+        print(f"clock sync barrier failed: {e!r}", file=sys.stderr)
+
     from boojum_tpu.prover import ProofConfig, generate_setup, prove, verify
 
     cfg = ProofConfig(fri_lde_factor=4, num_queries=8, fri_final_degree=8)
 
     result = {"pid": pid, "process_count": jax.process_count()}
+    if clock_sync is not None:
+        result["clock_sync"] = clock_sync
     if mode == "proofs":
         # proof-parallel across hosts: distribute_proofs slices the job
         # queue per process; WITHIN the process the jobs drain through

@@ -42,7 +42,23 @@ Usage:
       zero/absent wall, efficiency against a zero/absent device peak)
       FAILS, and a record claiming XLA actuals for kernels the compile
       ledger never recorded FAILS (attribution must never outrun the
-      evidence). Exits 1 on any problem.
+      evidence). Lines are routed by kind (ISSUE 15): blackbox
+      heartbeat/dump lines (utils/blackbox.py — a dump missing its
+      stacks or heartbeat trail fails) and fleet records are validated
+      by their own rules, so a report artifact interleaving forensics
+      with prove lines checks end to end. Exits 1 on any problem.
+
+  python scripts/prove_report.py --fleet HOST_FILE HOST_FILE... [--out F]
+      Merge per-host artifacts (multihost_worker result files — their
+      `prove_report_path` per-host report is followed automatically —
+      and/or per-host report .jsonl) into ONE mesh-wide fleet record:
+      clock-skew-aligned host roster (barrier-synchronized clock_sync
+      stamps, no NTP assumption), per-stage walls side by side with
+      across-host median/max, straggler detection (slowest host named
+      when it exceeds 1.5x the median by >= 50 ms), and per-host
+      ici/transfer byte rollups. --out writes the fleet record as JSON
+      (checkable with --check). Exits 1 when the merged record fails
+      its own validation.
 
   python scripts/prove_report.py --slo <report.jsonl>
       Aggregate the per-request SLO records of a proving-service
@@ -65,6 +81,8 @@ Usage:
   python scripts/prove_report.py --trend PATH [PATH...] [--gate]
       Per-stage perf trajectory over a history of artifacts — report
       .jsonl files, bench.py JSON lines, BENCH_*.json round wrappers,
+      MULTICHIP_r*.json wrappers (the metric line is recovered from the
+      captured tail; the round number from the filename),
       bench_micro.py line files; directories expand to their
       *.json/*.jsonl sorted by name. Series are grouped by the
       machine/software identity block when lines carry one, so micro
@@ -86,6 +104,7 @@ it works on machines without an accelerator stack and costs milliseconds.
 
 import argparse
 import importlib.util
+import json
 import os
 import sys
 
@@ -109,6 +128,71 @@ def _load_report_lib():
         from boojum_tpu.utils import report as mod  # type: ignore
 
         return mod
+
+
+def _load_fleet_host(path: str) -> tuple:
+    """Parse one per-host artifact into (label, docs). Accepts a
+    multihost_worker result file (single JSON object) or a per-host
+    report/blackbox JSONL; a result line's `prove_report_path` is
+    followed (also tried relative to the result file's directory, for
+    artifacts copied off the pod) so stage walls come along for free."""
+    base = os.path.basename(path)
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return os.path.splitext(base)[0], []
+    docs = []
+    try:
+        docs = [json.loads(text)]
+    except ValueError:
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                docs.append(json.loads(ln))
+            except ValueError:
+                continue
+    extra = []
+    for d in docs:
+        if not isinstance(d, dict):
+            continue
+        rp = d.get("prove_report_path")
+        if not isinstance(rp, str) or not rp:
+            continue
+        for cand in (
+            rp,
+            os.path.join(os.path.dirname(path), os.path.basename(rp)),
+        ):
+            if not os.path.isfile(cand):
+                continue
+            try:
+                with open(cand) as f:
+                    for ln in f:
+                        ln = ln.strip()
+                        if not ln:
+                            continue
+                        try:
+                            extra.append(json.loads(ln))
+                        except ValueError:
+                            continue
+            except OSError:
+                pass
+            break
+    docs.extend(extra)
+    label = None
+    for d in docs:
+        if (
+            isinstance(d, dict)
+            and isinstance(d.get("pid"), int)
+            and "process_count" in d
+        ):
+            label = f"host{d['pid']}"
+            break
+    if label is None:
+        label = os.path.splitext(base)[0]
+    return label, docs
 
 
 def main(argv=None) -> int:
@@ -139,8 +223,8 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--trend", nargs="+", metavar="PATH",
         help="per-stage perf trajectory over report artifacts / "
-             "BENCH_*.json history / bench_micro line files "
-             "(directories expand to *.json|*.jsonl)",
+             "BENCH_*.json + MULTICHIP_r*.json history / bench_micro "
+             "line files (directories expand to *.json|*.jsonl)",
     )
     ap.add_argument(
         "--gate", action="store_true",
@@ -150,6 +234,16 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--gate-threshold", type=float, default=0.2,
         help="relative regression threshold for --gate (default 0.2)",
+    )
+    ap.add_argument(
+        "--fleet", nargs="+", metavar="HOST_FILE",
+        help="merge per-host artifacts (multihost result files and/or "
+             "per-host report .jsonl) into one mesh-wide fleet record "
+             "with clock alignment and straggler detection",
+    )
+    ap.add_argument(
+        "--out", metavar="PATH",
+        help="with --fleet: also write the fleet record as JSON here",
     )
     ap.add_argument(
         "--index", type=int, default=-1,
@@ -169,13 +263,30 @@ def main(argv=None) -> int:
             return 1
         bad = 0
         for i, rep in enumerate(reports):
-            problems = rl.validate_report(rep)
+            problems = rl.validate_line(rep)
+            kind = rep.get("kind")
+            if kind == rl.BLACKBOX_KIND:
+                where = rep.get("span") or rep.get("phase") or "?"
+                desc = f"blackbox {rep.get('record')}"
+                if rep.get("record") == "dump":
+                    desc += f" [{rep.get('reason')}] at {where}"
+                else:
+                    desc += f" seq {rep.get('seq')} at {where}"
+            elif kind == rl.FLEET_KIND:
+                desc = (
+                    f"fleet — {rep.get('n_hosts')} hosts, "
+                    f"{len(rep.get('stragglers') or ())} straggler(s)"
+                )
+            else:
+                desc = None
             label = rep.get("label")
             if problems:
                 bad += 1
                 print(f"line {i} ({label!r}): INVALID")
                 for p in problems:
                     print(f"  - {p}")
+            elif desc is not None:
+                print(f"line {i} ({label!r}): ok — {desc}")
             else:
                 cov = rl.span_coverage(rep)
                 print(
@@ -184,6 +295,33 @@ def main(argv=None) -> int:
                     f"span coverage {cov * 100:.1f}%"
                 )
         return 1 if bad else 0
+
+    if args.fleet:
+        host_docs = []
+        seen: dict = {}
+        for p in args.fleet:
+            label, docs = _load_fleet_host(p)
+            # two result files from the same pid (copied runs) must stay
+            # distinct columns
+            if label in seen:
+                seen[label] += 1
+                label = f"{label}.{seen[label]}"
+            else:
+                seen[label] = 0
+            host_docs.append((label, docs))
+        rec = rl.fleet_merge(host_docs)
+        print(rl.render_fleet(rec))
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+            print(f"fleet record -> {args.out}")
+        problems = rl.validate_fleet(rec)
+        if problems:
+            print("PROBLEMS:")
+            for p in problems:
+                print(f"  - {p}")
+            return 1
+        return 0
 
     if args.slo:
         reports = rl.load_reports(args.slo)

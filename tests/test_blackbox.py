@@ -1,0 +1,563 @@
+"""Black-box forensics tests (ISSUE 15): heartbeat sidecar, stall /
+deadline / signal stack dumps, --check routing of blackbox records, and
+the fleet aggregation layer (`prove_report.py --fleet`) — all CPU-only
+and tier-1 fast.
+
+The two subprocess tests are the acceptance criteria verbatim: a
+simulated stall (injected sleep inside a stage) and a SIGTERM'd
+subprocess must BOTH leave a report artifact whose blackbox records pass
+`prove_report.py --check` and name the exact open span.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from boojum_tpu.utils import blackbox, report, spans
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _cli(argv):
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import prove_report as cli
+    finally:
+        sys.path.pop(0)
+    return cli.main(argv)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeats + progress
+# ---------------------------------------------------------------------------
+
+
+def test_heartbeat_stream_shape_and_validation(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    bb = blackbox.BlackBox(
+        sidecar=side, interval_s=0.05, stall_s=None, label="unit"
+    )
+    bb.set_phase("warmup")
+    bb.start()
+    try:
+        time.sleep(0.25)
+    finally:
+        bb.stop()
+    lines = _read_jsonl(side)
+    assert len(lines) >= 2
+    seqs = []
+    for rec in lines:
+        assert report.validate_line(rec) == [], rec
+        assert rec["kind"] == report.BLACKBOX_KIND
+        assert rec["record"] == "heartbeat"
+        assert rec["phase"] == "warmup"
+        assert rec["label"] == "unit"
+        seqs.append(rec["seq"])
+    assert seqs == sorted(seqs)
+    # rss is best-effort but always present on linux
+    assert "rss_kb" in lines[0]
+
+
+def test_progress_ticks_from_spans_and_checkpoints():
+    before = blackbox.progress()
+    with spans.span("anything"):
+        pass
+    assert blackbox.progress() > before
+    # checkpoint() ticks only on the recording path
+    log = report.CheckpointLog()
+    prev = report.install_checkpoint_log(log)
+    try:
+        before = blackbox.progress()
+        report.checkpoint(1, "witness_cap", [1, 2, 3])
+        assert blackbox.progress() > before
+    finally:
+        report.install_checkpoint_log(prev)
+
+
+def test_no_stall_dump_while_progress_flows(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    bb = blackbox.BlackBox(sidecar=side, interval_s=0.05, stall_s=0.4)
+    bb.start()
+    try:
+        for _ in range(12):
+            with spans.span("busy"):
+                time.sleep(0.05)
+    finally:
+        bb.stop()
+    assert all(r["record"] == "heartbeat" for r in _read_jsonl(side))
+
+
+# ---------------------------------------------------------------------------
+# Stall / deadline dumps (in-process)
+# ---------------------------------------------------------------------------
+
+
+def test_stall_dump_names_innermost_open_span(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    art = str(tmp_path / "report.jsonl")
+    bb = blackbox.BlackBox(
+        sidecar=side, interval_s=0.05, stall_s=0.25, report_path=art
+    )
+    bb.set_phase("warmup_prove")
+    rec = spans.SpanRecorder(sync=False)
+    prev = spans.install_recorder(rec)
+    bb.start()
+    try:
+        with spans.span("prove"):
+            with spans.span("round3_quotient"):
+                time.sleep(0.8)  # injected stall inside a stage
+    finally:
+        spans.install_recorder(prev)
+        bb.stop()
+    dumps = [r for r in _read_jsonl(side) if r["record"] == "dump"]
+    assert len(dumps) == 1, "stall must dump exactly once per freeze"
+    d = dumps[0]
+    assert report.validate_line(d) == [], d
+    assert d["reason"] == "stall"
+    assert d["span"] == "prove/round3_quotient"
+    assert d["phase"] == "warmup_prove"
+    assert d["stall_s"] == 0.25
+    # forensic payload: all-thread stacks, faulthandler text, partial
+    # span tree, recent heartbeat trail
+    assert any("MainThread" in s["thread"] for s in d["stacks"])
+    assert any(
+        "time.sleep" in ln or "test_stall" in ln
+        for s in d["stacks"]
+        for ln in s["stack"]
+    )
+    assert "Thread" in d["faulthandler"]
+    assert d["heartbeats"] and all(
+        h["record"] == "heartbeat" for h in d["heartbeats"]
+    )
+    names = {sp.get("name") for sp in d.get("spans", ())}
+    assert "prove" in names
+    # the dump was mirrored into the report artifact
+    art_dumps = _read_jsonl(art)
+    assert len(art_dumps) == 1
+    assert art_dumps[0]["reason"] == "stall"
+
+
+def test_stall_dump_rearms_after_progress_resumes(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    bb = blackbox.BlackBox(sidecar=side, interval_s=0.05, stall_s=0.2)
+    bb.start()
+    try:
+        time.sleep(0.5)  # first freeze
+        with spans.span("woke_up"):
+            pass
+        time.sleep(0.5)  # second freeze
+    finally:
+        bb.stop()
+    dumps = [r for r in _read_jsonl(side) if r["record"] == "dump"]
+    assert len(dumps) == 2
+    assert all(d["reason"] == "stall" for d in dumps)
+
+
+def test_deadline_dump_localizes_to_named_phase(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    bb = blackbox.BlackBox(sidecar=side, interval_s=0.05, stall_s=None)
+    bb.start()
+    try:
+        with bb.deadline("setup", 0.15):
+            time.sleep(0.5)
+        # an expired-and-exited deadline must not fire again
+        time.sleep(0.3)
+    finally:
+        bb.stop()
+    dumps = [r for r in _read_jsonl(side) if r["record"] == "dump"]
+    assert len(dumps) == 1
+    assert dumps[0]["reason"] == "deadline"
+    assert dumps[0]["deadline"] == "setup"
+    assert dumps[0]["overdue_s"] >= 0
+    assert report.validate_line(dumps[0]) == []
+
+
+def test_deadline_inside_budget_never_fires(tmp_path):
+    side = str(tmp_path / "bb.jsonl")
+    bb = blackbox.BlackBox(sidecar=side, interval_s=0.05, stall_s=None)
+    bb.start()
+    try:
+        with bb.deadline("fast_phase", 5.0):
+            time.sleep(0.15)
+    finally:
+        bb.stop()
+    assert all(r["record"] == "heartbeat" for r in _read_jsonl(side))
+
+
+# ---------------------------------------------------------------------------
+# Env-driven arming
+# ---------------------------------------------------------------------------
+
+
+def test_ensure_started_disabled_without_env(monkeypatch):
+    monkeypatch.delenv("BOOJUM_TPU_BLACKBOX", raising=False)
+    monkeypatch.delenv("BOOJUM_TPU_STALL_S", raising=False)
+    assert blackbox.current_blackbox() is None
+    assert blackbox.ensure_started(label="x") is None
+    assert blackbox.current_blackbox() is None
+
+
+def test_ensure_started_arms_from_env_and_is_idempotent(
+    tmp_path, monkeypatch
+):
+    side = str(tmp_path / "side.jsonl")
+    monkeypatch.setenv("BOOJUM_TPU_BLACKBOX", side)
+    monkeypatch.setenv("BOOJUM_TPU_BLACKBOX_INTERVAL", "0.05")
+    monkeypatch.setenv("BOOJUM_TPU_STALL_S", "30")
+    bb = blackbox.ensure_started(label="first")
+    try:
+        assert bb is not None and bb.running()
+        assert bb.sidecar == side
+        assert bb.stall_s == 30.0
+        assert blackbox.ensure_started(label="second") is bb
+        blackbox.set_phase("p1")
+        assert bb.phase == "p1"
+    finally:
+        bb.stop()
+        blackbox.install_blackbox(None)
+    assert _read_jsonl(side)
+
+
+# ---------------------------------------------------------------------------
+# Validators reject garbage
+# ---------------------------------------------------------------------------
+
+
+def test_validate_blackbox_rejects_malformed():
+    ok = {
+        "kind": report.BLACKBOX_KIND, "schema": 1, "record": "heartbeat",
+        "seq": 1, "t_s": 0.1, "unix_ts": 1000.0, "pid": 1,
+        "phase": "x", "progress": 0,
+    }
+    assert report.validate_blackbox(ok) == []
+    assert report.validate_blackbox({**ok, "kind": "nope"})
+    assert report.validate_blackbox({**ok, "schema": 99})
+    assert report.validate_blackbox({**ok, "record": "pulse"})
+    assert report.validate_blackbox({**ok, "seq": 0})
+    assert report.validate_blackbox({**ok, "progress": -1})
+    assert report.validate_blackbox({**ok, "t_s": float("nan")})
+    # a dump without its forensic payload must FAIL — an empty dump
+    # reading as valid is how an incident report goes silently blind
+    bare_dump = {**ok, "record": "dump", "reason": "stall", "stall_s": 5.0}
+    probs = report.validate_blackbox(bare_dump)
+    assert any("stacks" in p for p in probs)
+    assert any("faulthandler" in p for p in probs)
+    assert any("heartbeat trail" in p for p in probs)
+    full_dump = {
+        **bare_dump,
+        "stacks": [{"thread": "MainThread", "stack": ["File x, line 1"]}],
+        "faulthandler": "Thread 0x1 ...",
+        "heartbeats": [ok],
+    }
+    assert report.validate_blackbox(full_dump) == []
+    assert report.validate_blackbox({**full_dump, "stall_s": 0})
+    assert report.validate_blackbox(
+        {**full_dump, "reason": "deadline"}
+    )  # deadline dump without the deadline name
+
+
+def test_validate_fleet_rejects_inconsistencies():
+    rec = report.fleet_merge([
+        ("host0", [_mk_report({"round3_quotient": 1.0}, 2.0)]),
+        ("host1", [_mk_report({"round3_quotient": 1.1}, 2.2)]),
+    ])
+    assert report.validate_fleet(rec) == []
+    bad = json.loads(json.dumps(rec))
+    bad["stages"]["round3_quotient"]["max_host"] = "ghost"
+    assert any("max_host" in p for p in report.validate_fleet(bad))
+    bad2 = json.loads(json.dumps(rec))
+    bad2["n_hosts"] = 5
+    assert any("n_hosts" in p for p in report.validate_fleet(bad2))
+    bad3 = json.loads(json.dumps(rec))
+    bad3["stragglers"] = [{
+        "stage": "nope", "host": "host0", "wall_s": 1, "median_s": 1,
+        "ratio": 2.0,
+    }]
+    assert any("unknown" in p for p in report.validate_fleet(bad3))
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge
+# ---------------------------------------------------------------------------
+
+
+def _mk_report(stage_walls, wall, gauges=None):
+    children = [
+        {"name": n, "start_s": 0.0, "wall_s": w, "children": []}
+        for n, w in stage_walls.items()
+    ]
+    return {
+        "kind": report.REPORT_KIND, "schema": 3, "wall_s": wall,
+        "spans": [{
+            "name": "prove", "start_s": 0.0, "wall_s": wall,
+            "children": children,
+        }],
+        "metrics": {"counters": {}, "gauges": dict(gauges or {})},
+        "checkpoints": [],
+    }
+
+
+def test_fleet_merge_clock_alignment_and_straggler():
+    h0 = [
+        {"pid": 0, "process_count": 2, "proofs": {},
+         "clock_sync": {"barrier_unix_ts": 5000.0,
+                        "method": "sync_global_devices"}},
+        _mk_report(
+            {"round1_witness_commit": 1.0, "round3_quotient": 2.0}, 3.5,
+            gauges={"ici.all_gather.bytes": 1e6,
+                    "transfer.h2d_bytes": 2e6},
+        ),
+    ]
+    h1 = [
+        {"pid": 1, "process_count": 2, "proofs": {},
+         "clock_sync": {"barrier_unix_ts": 5000.75,
+                        "method": "sync_global_devices"}},
+        _mk_report(
+            {"round1_witness_commit": 1.1, "round3_quotient": 6.0}, 8.0,
+            gauges={"ici.all_gather.bytes": 3e6},
+        ),
+    ]
+    rec = report.fleet_merge([("host0", h0), ("host1", h1)])
+    assert report.validate_fleet(rec) == []
+    assert report.validate_line(rec) == []
+    # clock: barrier stamps -> offsets relative to the earliest host
+    assert rec["clock"]["method"] == "barrier"
+    assert rec["clock"]["max_skew_s"] == pytest.approx(0.75)
+    offs = {h["host"]: h["clock_offset_s"] for h in rec["hosts"]}
+    assert offs == {"host0": 0.0, "host1": pytest.approx(0.75)}
+    # straggler: round3 on host1 is 3x the median and > 50ms over
+    assert [s["stage"] for s in rec["stragglers"]] == ["round3_quotient"]
+    s = rec["stragglers"][0]
+    assert s["host"] == "host1" and s["ratio"] == pytest.approx(3.0)
+    # round1's 10% spread is NOT a straggler
+    assert "round1_witness_commit" in rec["stages"]
+    # byte rollups per host
+    by_host = {h["host"]: h for h in rec["hosts"]}
+    assert by_host["host0"]["ici_bytes"] == pytest.approx(1e6)
+    assert by_host["host0"]["transfer_bytes"] == pytest.approx(2e6)
+    assert by_host["host1"]["ici_bytes"] == pytest.approx(3e6)
+    # render names the straggler and the host columns
+    text = report.render_fleet(rec)
+    assert "STRAGGLER" in text and "round3_quotient" in text
+    assert "host0" in text and "host1" in text
+
+
+def test_fleet_merge_without_clock_stamps_degrades_explicitly():
+    rec = report.fleet_merge([
+        ("a", [_mk_report({"queries": 1.0}, 1.0)]),
+        ("b", [_mk_report({"queries": 1.0}, 1.0)]),
+    ])
+    assert rec["clock"]["method"] == "none"
+    assert "note" in rec["clock"]
+    assert report.validate_fleet(rec) == []
+
+
+def test_fleet_cli_merges_hosts_and_output_passes_check(
+    tmp_path, capsys
+):
+    # per-host result files pointing at per-host report artifacts —
+    # exactly what a multihost run leaves behind
+    for pid, (quot, ts) in enumerate([(2.0, 7000.0), (6.5, 7000.25)]):
+        rep_path = tmp_path / f"report.jsonl.host{pid}"
+        with open(rep_path, "w") as f:
+            f.write(json.dumps(_mk_report(
+                {"round1_witness_commit": 1.0, "round3_quotient": quot},
+                quot + 1.5,
+                gauges={"ici.psum.bytes": 1e5 * (pid + 1)},
+            )) + "\n")
+        with open(tmp_path / f"mh_{pid}.json", "w") as f:
+            json.dump({
+                "pid": pid, "process_count": 2, "proofs": {},
+                "clock_sync": {"barrier_unix_ts": ts,
+                               "method": "sync_global_devices"},
+                "prove_report_path": str(rep_path),
+            }, f)
+    out = tmp_path / "fleet.json"
+    rc = _cli([
+        "--fleet", str(tmp_path / "mh_0.json"), str(tmp_path / "mh_1.json"),
+        "--out", str(out),
+    ])
+    text = capsys.readouterr().out
+    assert rc == 0, text
+    assert "2 hosts" in text and "clock=barrier" in text
+    assert "STRAGGLER" in text and "host1" in text
+    # the emitted fleet record round-trips through --check
+    rc = _cli(["--check", str(out)])
+    text = capsys.readouterr().out
+    assert rc == 0, text
+    assert "fleet — 2 hosts" in text and "1 straggler" in text
+
+
+def test_check_routes_mixed_artifact_and_rejects_corruption(
+    tmp_path, capsys
+):
+    art = tmp_path / "mixed.jsonl"
+    hb = {
+        "kind": report.BLACKBOX_KIND, "schema": 1, "record": "heartbeat",
+        "seq": 1, "t_s": 0.1, "unix_ts": 1000.0, "pid": 4,
+        "phase": "warmup", "progress": 2,
+    }
+    with open(art, "w") as f:
+        f.write(json.dumps(_mk_report({"queries": 0.5}, 1.0)) + "\n")
+        f.write(json.dumps(hb) + "\n")
+    rc = _cli(["--check", str(art)])
+    text = capsys.readouterr().out
+    assert rc == 0, text
+    assert "blackbox heartbeat" in text
+    # corrupt blackbox line -> --check fails
+    with open(art, "a") as f:
+        f.write(json.dumps({**hb, "seq": -3, "record": "dump"}) + "\n")
+    rc = _cli(["--check", str(art)])
+    capsys.readouterr()
+    assert rc == 1
+
+
+# ---------------------------------------------------------------------------
+# Acceptance subprocess tests: injected stall + SIGTERM mid-stage
+# ---------------------------------------------------------------------------
+
+_CHILD_SRC = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {root!r})
+    from boojum_tpu.utils import blackbox, spans
+
+    bb = blackbox.ensure_started(label="child")
+    assert bb is not None and bb.running(), "env did not arm the blackbox"
+    spans.install_recorder(spans.SpanRecorder(sync=False))
+    print("armed", flush=True)
+    with spans.span("prove"):
+        with spans.span("round3_quotient"):
+            time.sleep({sleep_s})
+    bb.stop()
+    print("done", flush=True)
+""")
+
+
+def _spawn_child(tmp_path, sleep_s, stall_s=None):
+    art = str(tmp_path / "report.jsonl")
+    side = art + ".blackbox"
+    env = {
+        k: v for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BOOJUM_TPU_REPORT": art,
+        "BOOJUM_TPU_BLACKBOX": "1",
+        "BOOJUM_TPU_BLACKBOX_INTERVAL": "0.1",
+    })
+    if stall_s is not None:
+        env["BOOJUM_TPU_STALL_S"] = str(stall_s)
+    proc = subprocess.Popen(
+        [sys.executable, "-c",
+         _CHILD_SRC.format(root=REPO_ROOT, sleep_s=sleep_s)],
+        env=env, cwd=REPO_ROOT,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    return proc, art, side
+
+
+def _wait_for_beats(side, n, timeout_s=60.0):
+    t0 = time.time()
+    while time.time() - t0 < timeout_s:
+        try:
+            if len(_read_jsonl(side)) >= n:
+                return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"sidecar {side} never reached {n} beats")
+
+
+def test_simulated_stall_subprocess_localizes_to_stalled_span(tmp_path):
+    """Acceptance: an injected sleep inside a stage produces a blackbox
+    stack dump + heartbeat trail in the report artifact that --check
+    accepts and that names the stalled span."""
+    proc, art, side = _spawn_child(tmp_path, sleep_s=1.5, stall_s=0.4)
+    out, _ = proc.communicate(timeout=120)
+    assert proc.returncode == 0, out
+    dumps = [r for r in _read_jsonl(art) if r.get("record") == "dump"]
+    assert dumps, f"no dump in report artifact; child said: {out}"
+    d = dumps[0]
+    assert d["reason"] == "stall"
+    assert d["span"] == "prove/round3_quotient"
+    assert d["stacks"] and d["heartbeats"]
+    # the sidecar carries the heartbeat trail around the dump
+    side_recs = _read_jsonl(side)
+    assert [r for r in side_recs if r["record"] == "heartbeat"]
+    # the full artifact and the sidecar both pass --check
+    assert _cli(["--check", art]) == 0
+    assert _cli(["--check", side]) == 0
+
+
+def test_sigterm_subprocess_leaves_valid_flushed_artifact(tmp_path):
+    """Acceptance: a subprocess killed mid-stage (SIGTERM — the
+    `timeout -k` kill path) still leaves fsynced forensics naming the
+    open span."""
+    proc, art, side = _spawn_child(tmp_path, sleep_s=60)
+    _wait_for_beats(side, 2)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=120)
+    # the handler re-delivers with default disposition: killed-by-TERM
+    assert proc.returncode == -signal.SIGTERM, (proc.returncode, out)
+    dumps = [r for r in _read_jsonl(art) if r.get("record") == "dump"]
+    assert dumps, f"no dump in report artifact; child said: {out}"
+    d = dumps[0]
+    assert d["reason"] == "sigterm"
+    assert d["span"] == "prove/round3_quotient"
+    assert d["stacks"] and isinstance(d["faulthandler"], str)
+    assert _cli(["--check", art]) == 0
+    assert _cli(["--check", side]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Trend ingestion of MULTICHIP wrappers (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_trend_ingests_multichip_wrappers_ordered_by_round(tmp_path):
+    host = {"host_fp": "fp1", "device_kind": "cpu", "backend": "cpu"}
+    def bench_line(v):
+        return {"metric": "e2e_prove_wall", "value": v, "unit": "s",
+                "status": "ok", "host": host}
+    # BENCH wrappers carry n + parsed; MULTICHIP wrappers carry neither
+    # (round from the filename, metric line recovered from the tail)
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"n": 1, "rc": 0, "tail": "", "parsed": bench_line(10.0)}, f)
+    with open(tmp_path / "MULTICHIP_r02.json", "w") as f:
+        json.dump({
+            "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+            "tail": "xla noise\n" + json.dumps(bench_line(11.0)) + "\n",
+        }, f)
+    # a dead round (r03-style: empty tail) is skipped with a note, not
+    # a crash and not a bogus 0-valued point
+    with open(tmp_path / "MULTICHIP_r03.json", "w") as f:
+        json.dump({"n_devices": 8, "rc": 124, "ok": False,
+                   "skipped": False, "tail": ""}, f)
+    points, notes = report.load_trend_points([
+        str(tmp_path / "MULTICHIP_r02.json"),   # CLI order scrambled:
+        str(tmp_path / "MULTICHIP_r03.json"),   # round order must win
+        str(tmp_path / "BENCH_r01.json"),
+    ])
+    assert len(points) == 2
+    assert [p["label"] for p in points] == [
+        "BENCH_r01.json", "MULTICHIP_r02.json",
+    ]
+    assert any("MULTICHIP_r03" in n for n in notes)
+    # identity grouping is reused: both rounds share one gated series
+    series = report.trend_series(points)
+    key = [(i, n) for (i, n) in series if n == "total_wall"]
+    assert len(key) == 1
+    vals = [v for _l, v in series[key[0]]["points"]]
+    assert vals == [10.0, 11.0]
