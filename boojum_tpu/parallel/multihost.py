@@ -93,6 +93,7 @@ def initialize_multihost(
         from jax._src import distributed as _dist
 
         if _dist.global_state.client is not None:
+            _enable_cpu_collectives()
             return jax.process_count() > 1
     except Exception:
         pass
@@ -117,7 +118,29 @@ def initialize_multihost(
             # surface, not degrade every host to a duplicate run
             raise
         # else: genuine single-process run without a coordinator
+    _enable_cpu_collectives()
     return jax.process_count() > 1
+
+
+def _enable_cpu_collectives() -> None:
+    """Select the gloo TCP collectives backend for XLA:CPU when a
+    distributed runtime is up. XLA:CPU ships with NO cross-process
+    collectives by default, so every multiprocess CPU computation — the
+    prover's cross-host shard_map/GSPMD graphs, and even device_put onto
+    a process-spanning NamedSharding (its value-equality check compiles
+    a global psum) — dies with "Multiprocess computations aren't
+    implemented on the CPU backend". Must run BEFORE the backend
+    initializes (the flag is read at CPU client creation); on TPU the
+    flag only affects the auxiliary CPU client, so it is safe to set
+    whenever the distributed client exists."""
+    try:
+        from jax._src import distributed as _dist
+
+        if _dist.global_state.client is None:
+            return
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
 
 
 def hybrid_mesh(col_axis_per_host: int | None = None) -> Mesh:
@@ -157,6 +180,47 @@ def hybrid_mesh(col_axis_per_host: int | None = None) -> Mesh:
                 "per-column phases would cross DCN"
             )
     return Mesh(grid, axis_names=("col", "row"))
+
+
+def mesh_process_topology(mesh: Mesh) -> dict:
+    """Per-process device census of a mesh — the mesh-axis -> process-
+    topology mapping the DCN/ICI gauge split is computed from.
+
+    Returns {"devices": D, "processes": P, "local_devices": {pid: d_p}}
+    where d_p counts the mesh devices owned by process pid. Works on any
+    topology (a single-process mesh reports P == 1)."""
+    devs = list(np.asarray(mesh.devices).ravel())
+    counts: dict[int, int] = {}
+    for d in devs:
+        pid = int(getattr(d, "process_index", 0))
+        counts[pid] = counts.get(pid, 0) + 1
+    return {
+        "devices": len(devs),
+        "processes": len(counts),
+        "local_devices": counts,
+    }
+
+
+def dcn_fraction(mesh: Mesh) -> float:
+    """Fraction of a uniform collective's CROSSING bytes that cross the
+    process (DCN) boundary on this mesh; 0.0 on a single-process mesh.
+
+    For a D-device mesh split d_p devices per process, a uniform
+    all-to-all / all-gather moves each shard to every OTHER device with
+    equal weight, so of the D*(D-1) ordered (src, dst) device pairs the
+    cross-process ones number D^2 - sum_p d_p^2. The fraction
+
+        (D^2 - sum_p d_p^2) / (D^2 - D)
+
+    is therefore the same for both collective shapes — callers split the
+    crossing-byte bill into intra-host ICI and cross-host DCN portions
+    with one number per mesh."""
+    topo = mesh_process_topology(mesh)
+    d = topo["devices"]
+    if d <= 1 or topo["processes"] <= 1:
+        return 0.0
+    sq = sum(c * c for c in topo["local_devices"].values())
+    return float(d * d - sq) / float(d * (d - 1))
 
 
 def distribute_proofs(jobs, prove_fn, process_id: int | None = None,

@@ -77,18 +77,35 @@ def _interp() -> bool:
 # ---------------------------------------------------------------------------
 
 
+@lru_cache(maxsize=None)
+def _dcn_frac(mesh: Mesh) -> float:
+    """Cross-process fraction of this mesh's crossing bytes (cached per
+    mesh; 0.0 on any single-process topology)."""
+    from .multihost import dcn_fraction
+
+    return dcn_fraction(mesh)
+
+
 def _ici_all_to_all(nbytes_global: int, mesh: Mesh):
     """Tally one all-to-all layout pivot: (D-1)/D of the global payload
-    crosses the interconnect (each chip keeps its own 1/D slice)."""
+    crosses the interconnect (each chip keeps its own 1/D slice). On a
+    multi-host mesh the crossing bytes split intra-host (ici.*) vs
+    cross-process (dcn.*) by the mesh's process topology."""
     D = mesh_devices(mesh)
-    _metrics.count_ici_all_to_all(nbytes_global * (D - 1) / max(D, 1))
+    crossing = nbytes_global * (D - 1) / max(D, 1)
+    f = _dcn_frac(mesh)
+    _metrics.count_ici_all_to_all(crossing * (1.0 - f), crossing * f)
 
 
 def _ici_all_gather(nbytes_global: int, mesh: Mesh):
     """Tally one all-gather to replicated: every chip receives the
-    (D-1)/D it does not hold — D*(D-1)/D = (D-1) payloads total."""
+    (D-1)/D it does not hold — D*(D-1)/D = (D-1) payloads total. Same
+    ici/dcn split as the pivot (the crossing fraction is topology-
+    identical for both collective shapes)."""
     D = mesh_devices(mesh)
-    _metrics.count_ici_all_gather(nbytes_global * (D - 1))
+    crossing = nbytes_global * (D - 1)
+    f = _dcn_frac(mesh)
+    _metrics.count_ici_all_gather(crossing * (1.0 - f), crossing * f)
 
 
 class _pivot_timer:
@@ -611,22 +628,41 @@ def fri_commit_sm(cur, k: int, cap_size: int, mesh: Mesh):
     return node_layers_sm(dig, cap_size, mesh)
 
 
+def _demesh_array(arr, dev):
+    """One jax.Array onto a single LOCAL device. Fully-addressable arrays
+    move with a plain device_put; a multi-host global array spanning
+    non-addressable devices (for which that device_put is illegal) is
+    gathered to THIS host first — transfer.to_host rides
+    multihost_utils.process_allgather and bills the cross-host bytes to
+    the dcn.* gauges — then re-lands on the local device. Every process
+    gathers the same global value, so downstream single-device graphs
+    stay bit-identical across hosts."""
+    if getattr(arr, "is_fully_addressable", True):
+        return jax.device_put(arr, dev)
+    from ..utils import transfer as _transfer
+
+    return jax.device_put(_transfer.to_host(arr), dev)
+
+
 def demesh(arr):
     """Pull an array (or ext pair / MonomialSource / plane structures)
-    onto the default single device — the correctness fallback where a mesh
-    layout would send a plain jit through the SPMD partitioner (legacy
-    GSPMD round 5, streamed DEEP sources, deep FRI fold tails)."""
+    onto one local device — the correctness fallback where a mesh layout
+    would send a plain jit through the SPMD partitioner (legacy GSPMD
+    round 5, streamed DEEP sources, deep FRI fold tails). Addressable-
+    safe: on multi-host meshes non-addressable arrays gather to every
+    host (billed as dcn.host_gather_bytes) instead of attempting the
+    cross-process device_put that PR 5's single-device pull performed."""
     from ..prover.streaming import MonomialPlanesSource, MonomialSource
 
-    dev = jax.devices()[0]
+    dev = jax.local_devices()[0]
     if isinstance(arr, MonomialSource):
-        return MonomialSource(jax.device_put(arr.mono, dev), arr.L)
+        return MonomialSource(_demesh_array(arr.mono, dev), arr.L)
     if isinstance(arr, MonomialPlanesSource):
         return MonomialPlanesSource(demesh(arr.mono), arr.L)
     if isinstance(arr, tuple):
         return tuple(demesh(a) for a in arr)
     if isinstance(arr, jax.Array):
-        return jax.device_put(arr, dev)
+        return _demesh_array(arr, dev)
     return arr
 
 

@@ -51,9 +51,12 @@ def mesh_mode() -> str | None:
     path: NamedSharding constraints, XLA inserts the collectives).
 
     BOOJUM_TPU_MESH_MODE=shard_map|gspmd forces a mode. Unset defaults to
-    shard_map for single-process meshes; multi-process (DCN-spanning)
-    meshes keep gspmd — the explicit-collective path is validated over ICI
-    within one process, not across jax.distributed yet."""
+    shard_map on EVERY topology, including multi-process (DCN-spanning)
+    meshes under jax.distributed: the explicit collectives ride the same
+    all_gather/all_to_all primitives across hosts, the de-mesh fallbacks
+    are addressable-safe (shard_sweep.demesh gathers non-addressable
+    arrays per host), and the cross-host byte bill lands in the dcn.*
+    gauges. gspmd remains the forced legacy escape hatch."""
     m = active_mesh()
     if m is None:
         return None
@@ -66,11 +69,7 @@ def mesh_mode() -> str | None:
         raise ValueError(
             f"BOOJUM_TPU_MESH_MODE={v!r}: use shard_map or gspmd"
         )
-    try:
-        multi = jax.process_count() > 1
-    except Exception:
-        multi = False
-    return "gspmd" if multi else "shard_map"
+    return "shard_map"
 
 
 def shard_map_mesh() -> Mesh | None:

@@ -84,9 +84,16 @@ MANIFEST_NAME = "manifest.json"
 # compiled cache entries to be usable: the persistent-cache key covers
 # jax/backend identity, and XLA:CPU AOT code additionally embeds the
 # compile host's vector features (_hostfp.py — loading a mismatched
-# entry SIGILLs rather than missing)
+# entry SIGILLs rather than missing). Device topology is keyed on
+# (process_count, per-host device count), NOT the global device count:
+# every host of a multi-process run sees the same pair, so a bundle
+# built on host 0 of a pod warms hosts 1..P-1, while a single-host run
+# with the same TOTAL device count (which traces different local shapes)
+# correctly misses. Pre-16 manifests carrying only num_devices are
+# matched on that legacy key (see load_bundle).
 _PLATFORM_FIELDS = (
-    "jax", "jaxlib", "backend", "device_kind", "num_devices", "host_fp"
+    "jax", "jaxlib", "backend", "device_kind",
+    "num_local_devices", "process_count", "host_fp",
 )
 
 
@@ -196,12 +203,22 @@ def platform_info() -> dict:
         ndev = int(jax.device_count())
     except Exception:
         ndev = 0
+    try:
+        nloc = int(jax.local_device_count())
+    except Exception:
+        nloc = 0
+    try:
+        nproc = int(jax.process_count())
+    except Exception:
+        nproc = 1
     info = {
         "jax": jax.__version__,
         "jaxlib": jaxlib.__version__,
         "backend": jax.default_backend(),
         "device_kind": kind,
         "num_devices": ndev,
+        "num_local_devices": nloc,
+        "process_count": nproc,
         "host_fp": host_fingerprint(),
     }
     # memoize SUCCESSFUL probes only: a first call racing device
@@ -648,9 +665,17 @@ def load_bundle(
         )
     plat = platform_info()
     mplat = manifest.get("platform") or {}
+    fields = _PLATFORM_FIELDS
+    if "num_local_devices" not in mplat:
+        # pre-16 manifest: no process-topology keys — match on the
+        # legacy global device count instead
+        fields = tuple(
+            k for k in fields
+            if k not in ("num_local_devices", "process_count")
+        ) + ("num_devices",)
     stale = [
         f"{k}: bundle {mplat.get(k)!r} vs process {plat.get(k)!r}"
-        for k in _PLATFORM_FIELDS
+        for k in fields
         if mplat.get(k) != plat.get(k)
     ]
     if stale:
@@ -910,20 +935,15 @@ def load_and_warm(
 
 def _would_shard_map(mesh) -> bool:
     """Whether `prove(mesh=...)` will execute via shard_map — replicated
-    from parallel.sharding.mesh_mode WITHOUT needing the mesh active."""
+    from parallel.sharding.mesh_mode WITHOUT needing the mesh active.
+    shard_map is the default on every topology (including multi-process
+    jax.distributed meshes); gspmd only when forced by env."""
     if mesh is None:
         return False
     v = os.environ.get("BOOJUM_TPU_MESH_MODE", "").strip().lower()
-    if v in ("shard_map", "sm"):
-        return True
     if v == "gspmd":
         return False
-    try:
-        import jax
-
-        return jax.process_count() == 1
-    except Exception:
-        return False
+    return True
 
 
 _PROVE_ATTEMPTED: set[tuple] = set()

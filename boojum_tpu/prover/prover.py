@@ -682,16 +682,15 @@ def _u64_sweep_core(assembly, selector_paths, non_residues, lk_ctx):
 
 def _gspmd_demesh_ok() -> bool:
     """Whether the GSPMD u64-miscompile hardening (rounds 4-5 de-mesh,
-    replicated query gathers) can apply: single-process meshes only.
-    jax.device_put onto one device — or onto a replicated NamedSharding —
-    needs every device addressable, which fails across jax.distributed;
-    there the sharded round 4-5 graphs stay as before this hardening (the
-    multi-host GSPMD prove was validated bit-exact on hardware without
-    it — the miscompile was observed on the forced-8-device CPU mesh)."""
-    try:
-        return jax.process_count() == 1
-    except Exception:
-        return True
+    replicated query gathers) can apply: always, on every topology.
+    PR 5 gated this to single-process meshes because the de-mesh pull
+    onto one device needed every mesh device addressable; shard_sweep's
+    demesh is now addressable-safe (non-addressable arrays gather to
+    every host via multihost_utils.process_allgather, billed to the
+    dcn.* gauges, then land on the local device), so the hardening
+    holds across jax.distributed too — each host runs the identical
+    single-device rounds 4-5 graph over the identical gathered data."""
+    return True
 
 
 @partial(jax.jit, static_argnums=(2, 3))
@@ -2404,11 +2403,22 @@ def _prove_impl(assembly, setup, config: ProofConfig, clock) -> Proof:
         # leaf values observed on the forced-8-device CPU mesh, alongside
         # its "involuntary full rematerialization" warning). Gather from
         # explicitly replicated copies instead; the shard_map path keeps
-        # its layouts (its gathers came out bit-exact).
+        # its layouts (its gathers came out bit-exact). Across
+        # jax.distributed a replicated device_put of a non-addressable
+        # array is illegal — demesh those (per-host gather + local
+        # device), which removes the partially-replicated layouts just
+        # as thoroughly.
         from jax.sharding import NamedSharding, PartitionSpec
 
-        _rep = NamedSharding(active_mesh(), PartitionSpec())
-        arrs_ = tuple(jax.device_put(a, _rep) for a in arrs_)
+        if any(
+            not getattr(a, "is_fully_addressable", True) for a in arrs_
+        ):
+            from ..parallel.shard_sweep import demesh as _demesh_g
+
+            arrs_ = tuple(_demesh_g(a) for a in arrs_)
+        else:
+            _rep = NamedSharding(active_mesh(), PartitionSpec())
+            arrs_ = tuple(jax.device_put(a, _rep) for a in arrs_)
     elif shard_map_mesh() is not None and any(
         len(a.devices()) <= 1 for a in arrs_
     ):

@@ -94,16 +94,19 @@ def _flops(muls: float, adds: float) -> float:
 # not marketed bf16 TFLOPS.
 # ---------------------------------------------------------------------------
 
-# device_kind substring -> (peak integer GOP/s, HBM GB/s, ICI GB/s per link)
+# device_kind substring ->
+#   (peak integer GOP/s, HBM GB/s, ICI GB/s per link, DCN GB/s per host)
+# DCN is the cross-host fabric (data-center network) a multi-process mesh's
+# collectives cross; ~200 Gb/s NICs per TPU host -> 25 GB/s nominal.
 DEVICE_PEAKS = (
-    ("v5 lite", (394.0 * 16, 819.0, 186.0)),   # v5e: 8 MXU-adjacent VPUs
-    ("v5e", (394.0 * 16, 819.0, 186.0)),
-    ("v4", (275.0 * 16, 1228.0, 300.0)),
-    ("v3", (123.0 * 16, 900.0, 140.0)),
+    ("v5 lite", (394.0 * 16, 819.0, 186.0, 25.0)),  # v5e: 8 MXU-adj. VPUs
+    ("v5e", (394.0 * 16, 819.0, 186.0, 25.0)),
+    ("v4", (275.0 * 16, 1228.0, 300.0, 25.0)),
+    ("v3", (123.0 * 16, 900.0, 140.0, 25.0)),
     # XLA:CPU single-core nominal: a few int64 lanes at a few GHz
-    ("cpu", (20.0, 25.0, 0.0)),
+    ("cpu", (20.0, 25.0, 0.0, 0.0)),
 )
-_DEFAULT_PEAKS = (50.0, 50.0, 0.0)
+_DEFAULT_PEAKS = (50.0, 50.0, 0.0, 0.0)
 
 
 def cost_enabled() -> bool:
@@ -116,10 +119,10 @@ def cost_enabled() -> bool:
 
 def device_peaks() -> dict:
     """The active device's nominal peaks: {kind, peak_gflops,
-    peak_hbm_gbps, peak_ici_gbps, source}. BOOJUM_TPU_COST_PEAKS=
-    "gflops,hbm_gbps[,ici_gbps]" overrides the table (source:"env");
-    an unknown device kind falls to a conservative default
-    (source:"default")."""
+    peak_hbm_gbps, peak_ici_gbps, peak_dcn_gbps, source}.
+    BOOJUM_TPU_COST_PEAKS="gflops,hbm_gbps[,ici_gbps[,dcn_gbps]]"
+    overrides the table (source:"env"); an unknown device kind falls
+    to a conservative default (source:"default")."""
     kind = "unknown"
     try:
         import jax
@@ -136,10 +139,11 @@ def device_peaks() -> dict:
             parts = [float(x) for x in env.split(",")]
             gflops, hbm = parts[0], parts[1]
             ici = parts[2] if len(parts) > 2 else 0.0
+            dcn = parts[3] if len(parts) > 3 else 0.0
             return {
                 "kind": kind, "peak_gflops": gflops,
                 "peak_hbm_gbps": hbm, "peak_ici_gbps": ici,
-                "source": "env",
+                "peak_dcn_gbps": dcn, "source": "env",
             }
         except (ValueError, IndexError):
             try:
@@ -147,8 +151,8 @@ def device_peaks() -> dict:
 
                 _plog(
                     f"cost model: BOOJUM_TPU_COST_PEAKS={env!r} is not "
-                    f'"gflops,hbm_gbps[,ici_gbps]" — using the device '
-                    f"table"
+                    f'"gflops,hbm_gbps[,ici_gbps[,dcn_gbps]]" — using '
+                    f"the device table"
                 )
             except Exception:
                 pass
@@ -158,12 +162,13 @@ def device_peaks() -> dict:
             return {
                 "kind": kind, "peak_gflops": peaks[0],
                 "peak_hbm_gbps": peaks[1], "peak_ici_gbps": peaks[2],
-                "source": "table",
+                "peak_dcn_gbps": peaks[3], "source": "table",
             }
     return {
         "kind": kind, "peak_gflops": _DEFAULT_PEAKS[0],
         "peak_hbm_gbps": _DEFAULT_PEAKS[1],
-        "peak_ici_gbps": _DEFAULT_PEAKS[2], "source": "default",
+        "peak_ici_gbps": _DEFAULT_PEAKS[2],
+        "peak_dcn_gbps": _DEFAULT_PEAKS[3], "source": "default",
     }
 
 
@@ -267,6 +272,10 @@ def _acc(total: dict, part: dict, mult: float = 1.0):
     total["ici_bytes"] = total.get("ici_bytes", 0.0) + mult * part.get(
         "ici_bytes", 0.0
     )
+    if part.get("dcn_bytes"):
+        total["dcn_bytes"] = total.get("dcn_bytes", 0.0) + mult * part[
+            "dcn_bytes"
+        ]
     return total
 
 
@@ -563,11 +572,17 @@ def cost_sheet(specs, mesh_devices: int = 1) -> dict:
 from .report import PROVE_STAGES as STAGE_NAMES  # noqa: E402
 
 
-def stage_costs(sb, config, mesh_devices: int = 1) -> dict:
-    """Analytic per-stage {flops, hbm_bytes, ici_bytes} for one full
-    prove of a circuit in this ShapeBucket — multiplicities (Q coset
-    evals, per-oracle commits, the fold schedule) owned HERE, so the
-    per-kernel sheet stays per-dispatch."""
+def stage_costs(
+    sb, config, mesh_devices: int = 1, dcn_fraction: float = 0.0
+) -> dict:
+    """Analytic per-stage {flops, hbm_bytes, ici_bytes[, dcn_bytes]} for
+    one full prove of a circuit in this ShapeBucket — multiplicities
+    (Q coset evals, per-oracle commits, the fold schedule) owned HERE,
+    so the per-kernel sheet stays per-dispatch. On a multi-host mesh
+    `dcn_fraction` (parallel/multihost.dcn_fraction) splits every
+    modeled crossing-byte term into intra-host ici_bytes and cross-host
+    dcn_bytes — the same topology split the measured dcn.* gauges
+    carry."""
     from ..prover.fri import fold_schedule
 
     n = float(sb.trace_len)
@@ -648,8 +663,12 @@ def stage_costs(sb, config, mesh_devices: int = 1) -> dict:
         * (sb.B_all + 40.0) * 8 * math.log2(max(N, 2)),
         "ici_bytes": 0.0,
     }
+    f = min(max(float(dcn_fraction), 0.0), 1.0)
     for st in stages.values():
         st.setdefault("ici_bytes", 0.0)
+        if f > 0.0 and st["ici_bytes"] > 0.0:
+            st["dcn_bytes"] = st["ici_bytes"] * f
+            st["ici_bytes"] *= 1.0 - f
     return stages
 
 
@@ -713,6 +732,9 @@ def roofline(entry: dict, wall_s: float, peaks: dict) -> dict:
     ici = float(entry.get("ici_bytes", 0.0))
     if ici > 0:
         out["achieved_ici_gbps"] = _sig(ici / wall_s / 1e9)
+    dcn = float(entry.get("dcn_bytes", 0.0))
+    if dcn > 0:
+        out["achieved_dcn_gbps"] = _sig(dcn / wall_s / 1e9)
     eff = None
     if out.get("regime") == "compute" and pf > 0:
         eff = ag / pf
@@ -732,13 +754,16 @@ def build_cost_record(
     sheet: dict | None = None,
     mesh_devices: int = 1,
     peaks: dict | None = None,
+    dcn_fraction: float = 0.0,
 ) -> dict:
     """Assemble the report line's `cost` record (pure: everything it
     reads is already a dict/dataclass, so tests drive it with synthetic
     trees)."""
     peaks = peaks or device_peaks()
     walls = _stage_walls(span_tree)
-    stages = stage_costs(sb, config, mesh_devices=mesh_devices)
+    stages = stage_costs(
+        sb, config, mesh_devices=mesh_devices, dcn_fraction=dcn_fraction
+    )
     rec_stages = {}
     total = {"flops": 0.0, "hbm_bytes": 0.0, "ici_bytes": 0.0}
     total_wall = 0.0
@@ -766,6 +791,16 @@ def build_cost_record(
     ) + float(gauges.get("ici.all_gather_bytes", 0.0) or 0.0)
     if measured_ici > 0:
         record["total"]["ici_bytes_measured"] = round(measured_ici, 1)
+    measured_dcn = sum(
+        float(gauges.get(g, 0.0) or 0.0)
+        for g in (
+            "dcn.all_to_all_bytes",
+            "dcn.all_gather_bytes",
+            "dcn.host_gather_bytes",
+        )
+    )
+    if measured_dcn > 0:
+        record["total"]["dcn_bytes_measured"] = round(measured_dcn, 1)
     h2d = counters.get("transfer.h2d_bytes")
     d2h = counters.get("transfer.d2h_bytes")
     if isinstance(h2d, (int, float)) or isinstance(d2h, (int, float)):
@@ -923,7 +958,11 @@ def _mesh_devices(mesh_shape) -> int:
 # the registry families build_cost_record reports as MEASURED traffic;
 # cumulative on a long-lived registry (bench multi-rep runs), so the
 # prover snapshots them at prove start and the record carries the delta
-_MEASURED_GAUGES = ("ici.all_to_all_bytes", "ici.all_gather_bytes")
+_MEASURED_GAUGES = (
+    "ici.all_to_all_bytes", "ici.all_gather_bytes",
+    "dcn.all_to_all_bytes", "dcn.all_gather_bytes",
+    "dcn.host_gather_bytes",
+)
 _MEASURED_COUNTERS = ("transfer.h2d_bytes", "transfer.d2h_bytes")
 
 
@@ -1001,11 +1040,18 @@ def attach_cost_record(
 
         sb = shape_bucket(assembly, config)
         mesh_shape = None
+        dcn_frac = 0.0
         if mesh is not None:
             from ..prover.aot import _mesh_shape_list, _would_shard_map
 
             if _would_shard_map(mesh):
                 mesh_shape = _mesh_shape_list(mesh)
+                try:
+                    from ..parallel.multihost import dcn_fraction
+
+                    dcn_frac = dcn_fraction(mesh)
+                except Exception:
+                    dcn_frac = 0.0
         sheet = _cached_sheet(assembly, config, mesh_shape=mesh_shape)
         ledger = current_compile_ledger()
         ledger_costs = (
@@ -1023,6 +1069,7 @@ def attach_cost_record(
             ledger_costs=ledger_costs,
             sheet=sheet,
             mesh_devices=_mesh_devices(mesh_shape),
+            dcn_fraction=dcn_frac,
         )
         if rec is not None:
             rec.cost = record

@@ -202,26 +202,49 @@ def count_bytes_d2h(nbytes: int):
         reg.count("transfer.d2h_ops")
 
 
-def count_ici_all_to_all(crossing_bytes: float):
+def count_ici_all_to_all(crossing_bytes: float, dcn_bytes: float = 0.0):
     """Tally one explicit all-to-all layout pivot on the shard_map mesh
-    (parallel/shard_sweep.py). `crossing_bytes` is the portion of the
-    global payload that actually crosses the interconnect — the caller
-    owns the (D-1)/D topology math, this seam owns the gauge names:
+    (parallel/shard_sweep.py). `crossing_bytes` is the intra-host (ICI)
+    portion of the global payload that actually crosses the interconnect;
+    `dcn_bytes` is the cross-process (DCN) portion on a multi-host mesh —
+    the caller owns the (D-1)/D topology math and the DCN split
+    (parallel/multihost.dcn_fraction), this seam owns the gauge names:
     `ici.all_to_alls` / `ici.all_to_all_bytes` (and `ici.pivot_s` for the
-    dispatch window, charged by shard_sweep's pivot timer)."""
+    dispatch window, charged by shard_sweep's pivot timer), plus
+    `dcn.all_to_alls` / `dcn.all_to_all_bytes` whenever the collective
+    crossed a process boundary."""
     reg = current_registry()
     if reg is not None:
         reg.count("ici.all_to_alls")
         reg.gauge_add("ici.all_to_all_bytes", crossing_bytes)
+        if dcn_bytes > 0:
+            reg.count("dcn.all_to_alls")
+            reg.gauge_add("dcn.all_to_all_bytes", dcn_bytes)
 
 
-def count_ici_all_gather(crossing_bytes: float):
+def count_ici_all_gather(crossing_bytes: float, dcn_bytes: float = 0.0):
     """Tally one explicit all-gather to replicated (caps, small node
-    layers): `ici.all_gathers` / `ici.all_gather_bytes`."""
+    layers): `ici.all_gathers` / `ici.all_gather_bytes`, with the
+    cross-process portion split out as `dcn.all_gathers` /
+    `dcn.all_gather_bytes` (same contract as count_ici_all_to_all)."""
     reg = current_registry()
     if reg is not None:
         reg.count("ici.all_gathers")
         reg.gauge_add("ici.all_gather_bytes", crossing_bytes)
+        if dcn_bytes > 0:
+            reg.count("dcn.all_gathers")
+            reg.gauge_add("dcn.all_gather_bytes", dcn_bytes)
+
+
+def count_dcn_host_gather(dcn_bytes: float):
+    """Tally one host-side gather of a non-fully-addressable global array
+    (multihost_utils.process_allgather in transfer.to_host / the
+    addressable-safe demesh): `dcn.host_gathers` / `dcn.host_gather_bytes`
+    bill the bytes this process pulled from OTHER hosts over DCN."""
+    reg = current_registry()
+    if reg is not None:
+        reg.count("dcn.host_gathers")
+        reg.gauge_add("dcn.host_gather_bytes", dcn_bytes)
 
 
 def count_service_cache(event: str, nbytes: int = 0):

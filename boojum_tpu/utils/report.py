@@ -510,27 +510,46 @@ def validate_report(report: dict) -> list[str]:
             return v if isinstance(v, (int, float)) and v == v else 0
 
         for k, v in gauges.items():
-            if not k.startswith("ici."):
+            if not (k.startswith("ici.") or k.startswith("dcn.")):
                 continue
             if not isinstance(v, (int, float)) or v != v or v < 0:
                 problems.append(f"gauge {k}: invalid value {v!r}")
+        # a collective's crossing bytes may split intra-host (ici.*) vs
+        # cross-process (dcn.*) on a multi-host mesh — a counted
+        # collective must have moved bytes on at least one fabric
         if _num(counters.get("ici.all_to_alls", 0)) > 0:
-            if not _num(gauges.get("ici.all_to_all_bytes", 0)) > 0:
+            if not (
+                _num(gauges.get("ici.all_to_all_bytes", 0))
+                + _num(gauges.get("dcn.all_to_all_bytes", 0))
+            ) > 0:
                 problems.append(
-                    "ici.all_to_alls counted but ici.all_to_all_bytes "
-                    "gauge is missing/zero"
+                    "ici.all_to_alls counted but the ici.all_to_all_bytes "
+                    "+ dcn.all_to_all_bytes gauges are missing/zero"
                 )
             if "ici.pivot_s" not in gauges:
                 problems.append(
                     "ici.all_to_alls counted but ici.pivot_s gauge missing"
                 )
-        if _num(counters.get("ici.all_gathers", 0)) > 0 and not _num(
-            gauges.get("ici.all_gather_bytes", 0)
+        if _num(counters.get("ici.all_gathers", 0)) > 0 and not (
+            _num(gauges.get("ici.all_gather_bytes", 0))
+            + _num(gauges.get("dcn.all_gather_bytes", 0))
         ) > 0:
             problems.append(
-                "ici.all_gathers counted but ici.all_gather_bytes "
-                "gauge is missing/zero"
+                "ici.all_gathers counted but the ici.all_gather_bytes "
+                "+ dcn.all_gather_bytes gauges are missing/zero"
             )
+        # dcn.* counters carry the same counted-but-zero-bytes invariant
+        for fam, gname in (
+            ("dcn.all_to_alls", "dcn.all_to_all_bytes"),
+            ("dcn.all_gathers", "dcn.all_gather_bytes"),
+            ("dcn.host_gathers", "dcn.host_gather_bytes"),
+        ):
+            if _num(counters.get(fam, 0)) > 0 and not _num(
+                gauges.get(gname, 0)
+            ) > 0:
+                problems.append(
+                    f"{fam} counted but {gname} gauge is missing/zero"
+                )
         # service.* — the proving service's queue/cache/SLO axis. Every
         # value must be a finite non-negative number, and evictions must
         # carry their byte gauge (an eviction that freed zero bytes means
@@ -761,7 +780,7 @@ def _validate_cost(cost, ledger) -> list[str]:
         if not isinstance(st, dict):
             problems.append(f"cost stage {name}: not a dict")
             continue
-        for k in ("flops", "hbm_bytes", "ici_bytes"):
+        for k in ("flops", "hbm_bytes", "ici_bytes", "dcn_bytes"):
             v = st.get(k)
             if v is not None and (_bad(v) or v < 0):
                 problems.append(f"cost stage {name}: {k} invalid: {v!r}")
@@ -967,7 +986,7 @@ def validate_fleet(rec: dict) -> list[str]:
         stages = h.get("stages")
         if stages is not None and not isinstance(stages, dict):
             problems.append(f"host {h['host']}: stages malformed")
-        for k in ("ici_bytes", "transfer_bytes", "wall_s"):
+        for k in ("ici_bytes", "dcn_bytes", "transfer_bytes", "wall_s"):
             v = h.get(k)
             if v is not None and (
                 not isinstance(v, (int, float)) or v != v or v < 0
@@ -1084,30 +1103,36 @@ def _fleet_host_entry(label: str, docs: list[dict]) -> dict:
                 ici = _sum_gauges(m, ("ici.",), "bytes")
                 if ici is not None:
                     entry["ici_bytes"] = entry.get("ici_bytes", 0.0) + ici
+                dcn = _sum_gauges(m, ("dcn.",), "bytes")
+                if dcn is not None:
+                    entry["dcn_bytes"] = entry.get("dcn_bytes", 0.0) + dcn
                 xfer = _sum_gauges(m, ("transfer.", "limb."), "bytes")
                 if xfer is not None:
                     entry["transfer_bytes"] = (
                         entry.get("transfer_bytes", 0.0) + xfer
                     )
             continue
-        # multihost_worker result line: {pid, proofs, ici, clock_sync}
+        # multihost_worker result line: {pid, proofs, ici, dcn, clock_sync}
         if "pid" in d and ("proofs" in d or "clock_sync" in d or "ici" in d):
             if isinstance(d.get("pid"), int):
                 entry["pid"] = d["pid"]
+            if isinstance(d.get("mesh_mode"), str):
+                entry["mesh_mode"] = d["mesh_mode"]
             cs = d.get("clock_sync")
             if isinstance(cs, dict) and isinstance(
                 cs.get("barrier_unix_ts"), (int, float)
             ):
                 entry["barrier_unix_ts"] = cs["barrier_unix_ts"]
-            ici = d.get("ici")
-            if isinstance(ici, dict):
-                tot = sum(
-                    float(v)
-                    for k, v in ici.items()
-                    if "bytes" in k and isinstance(v, (int, float))
-                )
-                if tot:
-                    entry.setdefault("ici_bytes", tot)
+            for key, field in (("ici", "ici_bytes"), ("dcn", "dcn_bytes")):
+                fam = d.get(key)
+                if isinstance(fam, dict):
+                    tot = sum(
+                        float(v)
+                        for k, v in fam.items()
+                        if "bytes" in k and isinstance(v, (int, float))
+                    )
+                    if tot:
+                        entry.setdefault(field, tot)
             rp = d.get("prove_report_path")
             if isinstance(rp, str) and rp:
                 entry["prove_report_path"] = rp
@@ -1224,7 +1249,7 @@ def render_fleet(rec: dict) -> str:
     hosts = rec.get("hosts") or []
     lines.append(
         f"  {'host':<16} {'offset_s':>9} {'wall_s':>9} "
-        f"{'ici_MB':>9} {'xfer_MB':>9} {'dumps':>6}"
+        f"{'ici_MB':>9} {'dcn_MB':>9} {'xfer_MB':>9} {'dumps':>6}"
     )
     for h in hosts:
         def _mb(v):
@@ -1237,6 +1262,7 @@ def render_fleet(rec: dict) -> str:
             f"{off if off is not None else '-':>9} "
             f"{f'{wall:.3f}' if isinstance(wall, (int, float)) else '-':>9} "
             f"{_mb(h.get('ici_bytes')):>9} "
+            f"{_mb(h.get('dcn_bytes')):>9} "
             f"{_mb(h.get('transfer_bytes')):>9} "
             f"{h.get('dumps', 0):>6}"
         )
@@ -1926,6 +1952,19 @@ def _point_values_from_report(rep: dict) -> dict:
                 values[f"efficiency:{st}"] = {
                     "value": float(eff), "unit": "frac"
                 }
+    # cross-host byte gauges (multi-host shard_map proves): dcn:<name>
+    # series gate DCN traffic regressions on MULTICHIP rounds
+    metrics = rep.get("metrics")
+    if isinstance(metrics, dict):
+        for k, v in (metrics.get("gauges") or {}).items():
+            if (
+                k.startswith("dcn.")
+                and k.endswith("bytes")
+                and isinstance(v, (int, float))
+            ):
+                values[f"dcn:{k[len('dcn.'):]}"] = {
+                    "value": float(v), "unit": "B"
+                }
     return values
 
 
@@ -1946,6 +1985,16 @@ def _point_values_from_bench(line: dict) -> dict:
         for nm, w in stages.items():
             if isinstance(w, (int, float)):
                 values[f"stage:{nm}"] = {"value": float(w), "unit": "s"}
+    # multihost worker/bench lines carrying a per-mode dcn gauge dict
+    # (scripts/multihost_worker.py result stamps) feed the same dcn:
+    # series as report lines
+    dcn = line.get("dcn")
+    if isinstance(dcn, dict):
+        for k, v in dcn.items():
+            if "bytes" not in k or not isinstance(v, (int, float)):
+                continue
+            name = k[len("dcn."):] if k.startswith("dcn.") else k
+            values[f"dcn:{name}"] = {"value": float(v), "unit": "B"}
     return values
 
 
@@ -2118,8 +2167,12 @@ def load_trend_points(paths: list[str]) -> tuple[list[dict], list[str]]:
 
 def _series_direction(unit: str) -> str | None:
     """'lower' / 'higher' = which direction is BETTER; None = not
-    gated (dimensionless series ride the table only)."""
+    gated (dimensionless series ride the table only). Byte series
+    (the dcn:* cross-host traffic gauges) gate lower-is-better: a
+    multi-host round that suddenly moves more DCN bytes regressed."""
     if unit == "s":
+        return "lower"
+    if unit == "B":
         return "lower"
     if unit.endswith("/s"):
         return "higher"
@@ -2170,9 +2223,10 @@ def trend_gate(
     """Regression verdicts: for every gated series with >= min_points
     points, compare the LAST point against the MEDIAN of its
     predecessors; a lower-is-better series regresses when the last point
-    exceeds baseline*(1+threshold) (and, for seconds, by at least
-    min_abs_s — sub-50ms jitter is noise, not regression); a
-    higher-is-better series regresses below baseline*(1-threshold)."""
+    exceeds baseline*(1+threshold) (and by an absolute noise floor:
+    min_abs_s for seconds — sub-50ms jitter is noise, not regression —
+    1 KiB for byte series); a higher-is-better series regresses below
+    baseline*(1-threshold)."""
     regressions = []
     for (identity, name), slot in sorted(series.items()):
         direction = _series_direction(slot.get("unit", ""))
@@ -2188,9 +2242,10 @@ def trend_gate(
             continue
         bad = False
         if direction == "lower":
-            bad = (
-                last > base * (1.0 + threshold)
-                and (slot.get("unit") != "s" or (last - base) >= min_abs_s)
+            unit = slot.get("unit")
+            floor = {"s": min_abs_s, "B": 1024.0}.get(unit)
+            bad = last > base * (1.0 + threshold) and (
+                floor is None or (last - base) >= floor
             )
         else:
             bad = last < base * (1.0 - threshold)

@@ -92,28 +92,75 @@ def _needs_allgather(x) -> bool:
         return False
 
 
+def _owning_processes(x) -> list[int]:
+    """Sorted process indices owning any shard of a global array."""
+    try:
+        return sorted(
+            {int(getattr(d, "process_index", 0)) for d in x.sharding.device_set}
+        )
+    except Exception:
+        return []
+
+
+def _addressable_nbytes(x) -> int:
+    """Bytes of `x` already resident on THIS host's devices (shard
+    metadata only — nothing is transferred)."""
+    try:
+        return int(
+            sum(
+                s.data.size * s.data.dtype.itemsize
+                for s in x.addressable_shards
+            )
+        )
+    except Exception:
+        return 0
+
+
 def to_host(x):
     """Blocking device->host pull; np.asarray that also works for
     MULTI-PROCESS global arrays (a sharded jax.Array spanning
     non-addressable devices cannot be fetched directly — gather it to
-    every host first). Plain numpy/host values pass straight through.
+    every host first, billing the cross-host bytes to the `dcn.*`
+    gauges). Plain numpy/host values pass straight through.
+
+    When the cross-host gather itself fails, raise a clear error naming
+    the owning processes and the addressable-shards escape hatch instead
+    of falling through to np.asarray's opaque span-of-non-addressable-
+    devices failure.
 
     This is the pipeline's unit of host blocking: one call = one
     `host.blocking_syncs` tick + d2h byte accounting (no-ops without a
     metrics registry)."""
     was_device = _is_device_array(x)
     if was_device and _needs_allgather(x):
+        local_nbytes = _addressable_nbytes(x)
         try:
             from jax.experimental import multihost_utils
 
             out = np.asarray(
                 multihost_utils.process_allgather(x, tiled=True)
             )
-            _metrics.count_bytes_d2h(out.nbytes)
-            _metrics.count("host.blocking_syncs")
-            return out
-        except Exception:
-            pass
+        except Exception as e:
+            import jax
+
+            owners = _owning_processes(x)
+            raise RuntimeError(
+                f"to_host: array {getattr(x, 'shape', '?')} spans "
+                "non-addressable devices (owned by processes "
+                f"{owners or '?'}; this is process {jax.process_index()} "
+                f"of {jax.process_count()}) and the cross-host gather "
+                f"(multihost_utils.process_allgather) failed: {e!r}. "
+                "Only this host's addressable shards can be fetched "
+                "without a collective — use "
+                "[np.asarray(s.data) for s in x.addressable_shards] for "
+                "the per-host partial view."
+            ) from e
+        # every gathered byte NOT already resident on this host's shards
+        # arrived over the cross-process (DCN) fabric
+        _metrics.count_dcn_host_gather(max(out.nbytes - local_nbytes, 0))
+        _metrics.count_bytes_d2h(out.nbytes)
+        _metrics.count("host.blocking_syncs")
+        return out
     out = np.asarray(x)
     if was_device:
         _metrics.count_bytes_d2h(out.nbytes)

@@ -34,6 +34,14 @@ def pytest_configure(config):
         "gateway: binds loopback HTTP sockets (-m 'not gateway' to skip "
         "on sandboxed runners)",
     )
+    # multi-process jax.distributed tests (subprocess pairs over a
+    # loopback coordinator): slow-lane by construction, selected
+    # explicitly by scripts/ci_gate.sh --multihost via -m multihost
+    config.addinivalue_line(
+        "markers",
+        "multihost: spawns jax.distributed subprocess pairs "
+        "(ci_gate.sh --multihost runs these)",
+    )
 
 
 def pytest_collection_modifyitems(items):

@@ -9,10 +9,17 @@
 #      --trend --gate: last point of every stage/metric series vs the
 #      median of its predecessors, 20% + 50 ms noise floor).
 #
-# Exits nonzero when either fails. Knobs:
-#   CI_GATE_TIMEOUT_S   tier-1 budget in seconds (default 870, as in
-#                       ROADMAP.md; the -k kill grace stays 10 s)
-#   CI_GATE_THRESHOLD   relative regression threshold (default 0.2)
+# With --multihost, a third leg runs the two-process jax.distributed
+# parity tests (subprocess pairs over a loopback coordinator — proof
+# bytes and Fiat-Shamir checkpoints must be bit-identical gspmd vs
+# multi-host shard_map). Slow: real CPU proves per process; not part
+# of the default invocation.
+#
+# Exits nonzero when any requested leg fails. Knobs:
+#   CI_GATE_TIMEOUT_S     tier-1 budget in seconds (default 870, as in
+#                         ROADMAP.md; the -k kill grace stays 10 s)
+#   CI_GATE_THRESHOLD     relative regression threshold (default 0.2)
+#   CI_GATE_MH_TIMEOUT_S  --multihost leg budget in seconds (default 3600)
 set -u -o pipefail
 
 root="$(cd "$(dirname "$0")/.." && pwd)"
@@ -20,6 +27,17 @@ cd "$root"
 
 timeout_s="${CI_GATE_TIMEOUT_S:-870}"
 threshold="${CI_GATE_THRESHOLD:-0.2}"
+mh_timeout_s="${CI_GATE_MH_TIMEOUT_S:-3600}"
+multihost=0
+for arg in "$@"; do
+    case "$arg" in
+        --multihost) multihost=1 ;;
+        *)
+            echo "ci_gate: unknown argument $arg (supported: --multihost)" >&2
+            exit 2
+            ;;
+    esac
+done
 rc=0
 
 echo "== ci_gate: tier-1 tests (budget ${timeout_s}s) =="
@@ -57,6 +75,25 @@ else
         echo "ci_gate: no usable trend points; gate skipped"
     else
         echo "ci_gate: perf trend gate ok"
+    fi
+fi
+
+if [ "$multihost" -eq 1 ]; then
+    echo "== ci_gate: multihost parity leg (budget ${mh_timeout_s}s) =="
+    # -m multihost selects the jax.distributed subprocess-pair tests
+    # (registered in conftest.py); BOOJUM_TPU_TWO_PROC_TESTS lifts
+    # their default skip
+    timeout -k 10 "$mh_timeout_s" env JAX_PLATFORMS=cpu \
+        BOOJUM_TPU_TWO_PROC_TESTS=1 \
+        python -m pytest tests/test_multihost.py -q -m multihost \
+        --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    mh_rc=$?
+    if [ "$mh_rc" -ne 0 ]; then
+        echo "ci_gate: multihost parity leg FAILED (rc=$mh_rc)"
+        rc=1
+    else
+        echo "ci_gate: multihost parity leg ok"
     fi
 fi
 

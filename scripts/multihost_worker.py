@@ -77,13 +77,26 @@ def build_circuit(seed: int):
 
 
 def main():
-    mode, port, pid, nprocs, out_path = (
-        sys.argv[1],
-        int(sys.argv[2]),
-        int(sys.argv[3]),
-        int(sys.argv[4]),
-        sys.argv[5],
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("mode", choices=["proofs", "hybrid"])
+    ap.add_argument("port", type=int)
+    ap.add_argument("pid", type=int)
+    ap.add_argument("nprocs", type=int)
+    ap.add_argument("out_path")
+    ap.add_argument(
+        "--mesh-mode", choices=["shard_map", "gspmd"], default=None,
+        help="force the hybrid prove's mesh execution mode (sets "
+        "BOOJUM_TPU_MESH_MODE before the prove; default: the prover's "
+        "own default, shard_map on every topology)",
     )
+    args = ap.parse_args()
+    mode, port, pid, nprocs, out_path = (
+        args.mode, args.port, args.pid, args.nprocs, args.out_path
+    )
+    if args.mesh_mode:
+        os.environ["BOOJUM_TPU_MESH_MODE"] = args.mesh_mode
     from boojum_tpu.parallel.multihost import (
         distribute_proofs,
         hybrid_mesh,
@@ -100,13 +113,15 @@ def main():
 
     # flight recorder, per-host: point each process at its own ProveReport
     # artifact (JSONL appends from two processes into one file would
-    # interleave); prove() auto-records once the env var is set
-    report_base = os.environ.get("BOOJUM_TPU_REPORT")
-    if report_base:
-        report_path = f"{report_base}.host{pid}"
-        os.environ["BOOJUM_TPU_REPORT"] = report_path
-    else:
-        report_path = None
+    # interleave); prove() auto-records once the env var is set. With no
+    # BOOJUM_TPU_REPORT configured the recorder is armed anyway, next to
+    # the result file — MULTICHIP rounds must always record which path
+    # (mesh_mode) and which fabric (ici/dcn gauges) actually ran
+    report_base = os.environ.get("BOOJUM_TPU_REPORT") or (
+        out_path + ".report.jsonl"
+    )
+    report_path = f"{report_base}.host{pid}"
+    os.environ["BOOJUM_TPU_REPORT"] = report_path
 
     # black-box forensics (ISSUE 15): with BOOJUM_TPU_BLACKBOX /
     # BOOJUM_TPU_STALL_S armed, a host wedged inside a cross-process
@@ -121,6 +136,40 @@ def main():
         _blackbox.set_phase(f"multihost_{mode}")
     except Exception:
         pass
+
+    # hard deadline (ISSUE 16): XLA:CPU's gloo collectives have NO
+    # timeout — a cross-process rendezvous whose peer never arrives
+    # (observed once on a cold compile cache) blocks forever with zero
+    # CPU. Exit 3 with stacks after BOOJUM_TPU_MH_DEADLINE_S (default
+    # 1800 s, generous for cold cross-host compiles; 0 disables) so a
+    # wedged pair fails the CI leg fast and with forensics instead of
+    # silently burning the harness timeout.
+    deadline_s = float(os.environ.get("BOOJUM_TPU_MH_DEADLINE_S", "1800"))
+    if deadline_s > 0:
+        import faulthandler
+        import threading
+
+        def _deadline_abort():
+            print(
+                f"multihost_worker pid={pid}: deadline "
+                f"{deadline_s}s exceeded, dumping stacks and exiting",
+                file=sys.stderr,
+            )
+            faulthandler.dump_traceback(file=sys.stderr)
+            try:
+                from boojum_tpu.utils import blackbox as _bb
+
+                bb = _bb.current_blackbox()
+                if bb is not None:
+                    bb.dump("deadline", deadline_s=deadline_s)
+            except Exception:
+                pass
+            sys.stderr.flush()
+            os._exit(3)
+
+        _t = threading.Timer(deadline_s, _deadline_abort)
+        _t.daemon = True
+        _t.start()
 
     # barrier-synchronized wall-clock stamp (ISSUE 15 satellite): every
     # process reads time.time() immediately after passing the SAME
@@ -209,31 +258,50 @@ def main():
     elif mode == "hybrid":
         mesh = hybrid_mesh(col_axis_per_host=2)
         assert mesh.shape["col"] == nprocs * 2, dict(mesh.shape)
+        # record which execution path this prove will take (shard_map =
+        # native limb kernels + explicit collectives; gspmd = legacy
+        # XLA-partitioned u64) — the parity test and MULTICHIP triage
+        # both key on this stamp
+        from boojum_tpu.parallel.sharding import (
+            mesh_mode as _mesh_mode,
+            prover_mesh as _prover_mesh,
+        )
+
+        with _prover_mesh(mesh):
+            result["mesh_mode"] = _mesh_mode()
         asm = build_circuit(0).into_assembly()
         setup = generate_setup(asm, cfg)
         proof = prove(asm, setup, cfg, mesh=mesh)
         result["proof"] = proof.to_json()
     else:
         raise SystemExit(f"unknown mode {mode}")
+    result.setdefault("mesh_mode", "none")
 
     if report_path is not None:
         result["prove_report_path"] = report_path
-        # surface the explicit-collective bill (ISSUE 5) on the per-host
-        # line itself: the ici.* gauges/counters of the LAST prove of this
-        # host, so multi-host runs are triageable without opening every
+        # surface the explicit-collective bill (ISSUE 5) and its
+        # cross-host split (ISSUE 16) on the per-host line itself: the
+        # ici.*/dcn.* gauges/counters of the LAST prove of this host,
+        # plus its Fiat-Shamir digest checkpoints, so multi-host runs
+        # are triageable (and parity-checkable) without opening every
         # ProveReport artifact
         try:
             with open(report_path) as f:
                 lines = [ln for ln in f if ln.strip()]
-            metrics = json.loads(lines[-1]).get("metrics") or {}
-            result["ici"] = {
-                k: v
-                for src in ("gauges", "counters")
-                for k, v in (metrics.get(src) or {}).items()
-                if k.startswith("ici.")
-            }
+            last = json.loads(lines[-1])
+            metrics = last.get("metrics") or {}
+            for fam in ("ici", "dcn"):
+                result[fam] = {
+                    k: v
+                    for src in ("gauges", "counters")
+                    for k, v in (metrics.get(src) or {}).items()
+                    if k.startswith(f"{fam}.")
+                }
+            if isinstance(last.get("checkpoints"), list):
+                result["checkpoints"] = last["checkpoints"]
         except (OSError, ValueError, IndexError):
             result["ici"] = {}
+            result["dcn"] = {}
 
     with open(out_path, "w") as f:
         json.dump(result, f)

@@ -1,0 +1,380 @@
+"""DCN/ICI split of the collective bill (ISSUE 16).
+
+The multi-host native prover splits every explicit collective's
+crossing bytes into intra-host ICI vs cross-process DCN portions
+(parallel/multihost.dcn_fraction), bills host gathers of
+non-addressable arrays to dcn.host_gather_*, prices DCN in the cost
+model (peak/column), validates the new gauge families on report lines,
+rolls them into fleet host entries, and gates dcn:* byte series in the
+trend. These are the FAST (single-process, no jax.distributed) pins of
+that plumbing; tests/test_multihost.py's two-process parity test drives
+the real cross-host path.
+"""
+
+import numpy as np
+
+from boojum_tpu.utils import costmodel as cm
+from boojum_tpu.utils import metrics as M
+from boojum_tpu.utils import report
+
+
+class _FakeDev:
+    def __init__(self, process_index):
+        self.process_index = process_index
+
+
+class _FakeMesh:
+    def __init__(self, pids):
+        self.devices = np.array([_FakeDev(p) for p in pids], dtype=object)
+
+
+# ---------------------------------------------------------------------------
+# topology math
+# ---------------------------------------------------------------------------
+
+
+def test_dcn_fraction_single_process_is_zero():
+    import jax
+
+    from boojum_tpu.parallel.multihost import (
+        dcn_fraction,
+        hybrid_mesh,
+        mesh_process_topology,
+    )
+
+    mesh = hybrid_mesh()
+    topo = mesh_process_topology(mesh)
+    assert topo["devices"] == len(jax.devices())
+    assert topo["processes"] == 1
+    assert dcn_fraction(mesh) == 0.0
+
+
+def test_dcn_fraction_two_hosts_two_chips():
+    from boojum_tpu.parallel.multihost import dcn_fraction
+
+    # D=4 over 2 processes x 2 devices: crossing pairs 4^2-4=12, of
+    # which 4^2 - (2^2 + 2^2) = 8 cross the process boundary -> 2/3
+    mesh = _FakeMesh([0, 0, 1, 1])
+    assert abs(dcn_fraction(mesh) - 2.0 / 3.0) < 1e-12
+
+
+def test_dcn_fraction_heterogeneous_hosts():
+    from boojum_tpu.parallel.multihost import dcn_fraction
+
+    # D=4 split 3+1: (16 - (9+1)) / 12 = 0.5
+    mesh = _FakeMesh([0, 0, 0, 1])
+    assert abs(dcn_fraction(mesh) - 0.5) < 1e-12
+    # one device: no crossing at all
+    assert dcn_fraction(_FakeMesh([0])) == 0.0
+
+
+def test_shard_sweep_accounting_splits_by_fraction():
+    """_ici_all_to_all / _ici_all_gather route the dcn_fraction split
+    through the metrics seams: ici gauges carry the intra-host portion,
+    dcn gauges the cross-host remainder, and their sum is the full
+    crossing bill."""
+    from boojum_tpu.parallel import shard_sweep as ss
+
+    class _Shaped(_FakeMesh):
+        # shard_sweep.mesh_devices reads mesh.shape
+        shape = {"col": 4, "row": 1}
+
+        def __hash__(self):
+            return id(self)
+
+        def __eq__(self, other):
+            return self is other
+
+    mesh = _Shaped([0, 0, 1, 1])
+    reg = M.MetricsRegistry()
+    tok = M.install_scoped_registry(reg)
+    try:
+        ss._ici_all_to_all(1200, mesh)  # crossing = 1200*3/4 = 900
+        ss._ici_all_gather(100, mesh)   # crossing = 100*3 = 300
+    finally:
+        M.reset_scoped_registry(tok)
+    g = reg.to_dict()["gauges"]
+    c = reg.to_dict()["counters"]
+    assert c["ici.all_to_alls"] == 1 and c["dcn.all_to_alls"] == 1
+    assert c["ici.all_gathers"] == 1 and c["dcn.all_gathers"] == 1
+    assert abs(g["ici.all_to_all_bytes"] - 300.0) < 1e-6
+    assert abs(g["dcn.all_to_all_bytes"] - 600.0) < 1e-6
+    assert abs(g["ici.all_gather_bytes"] - 100.0) < 1e-6
+    assert abs(g["dcn.all_gather_bytes"] - 200.0) < 1e-6
+
+
+def test_metrics_seams_no_dcn_on_single_host():
+    reg = M.MetricsRegistry()
+    tok = M.install_scoped_registry(reg)
+    try:
+        M.count_ici_all_to_all(100.0)       # no dcn arg: single-host
+        M.count_ici_all_gather(50.0, 0.0)   # explicit zero
+    finally:
+        M.reset_scoped_registry(tok)
+    snap = reg.to_dict()
+    assert not any(k.startswith("dcn.") for k in snap["counters"])
+    assert not any(k.startswith("dcn.") for k in snap["gauges"])
+
+
+# ---------------------------------------------------------------------------
+# cost model: DCN column + peak
+# ---------------------------------------------------------------------------
+
+
+def test_device_peaks_carry_dcn(monkeypatch):
+    assert "peak_dcn_gbps" in cm.device_peaks()
+    monkeypatch.setenv("BOOJUM_TPU_COST_PEAKS", "100,50,10,25")
+    p = cm.device_peaks()
+    assert p["source"] == "env"
+    assert p["peak_ici_gbps"] == 10.0 and p["peak_dcn_gbps"] == 25.0
+
+
+def test_stage_costs_dcn_split_preserves_crossing_total():
+    from boojum_tpu.prover.shape_key import shape_bucket
+    from tests.test_costmodel import _fma_cfg_asm
+
+    asm, cfg = _fma_cfg_asm()
+    sb = shape_bucket(asm, cfg)
+    base = cm.stage_costs(sb, cfg, mesh_devices=8)
+    split = cm.stage_costs(sb, cfg, mesh_devices=8, dcn_fraction=0.25)
+    for name, ent in base.items():
+        s = split[name]
+        if ent["ici_bytes"] == 0:
+            assert "dcn_bytes" not in s
+            continue
+        assert s["dcn_bytes"] > 0
+        assert abs(
+            (s["ici_bytes"] + s["dcn_bytes"]) - ent["ici_bytes"]
+        ) < 1e-6
+        assert abs(s["dcn_bytes"] - ent["ici_bytes"] * 0.25) < 1e-6
+    # no fraction -> no dcn key anywhere (single-host records unchanged)
+    assert all("dcn_bytes" not in e for e in base.values())
+
+
+def test_roofline_achieved_dcn_gbps():
+    peaks = {"peak_gflops": 100.0, "peak_hbm_gbps": 50.0}
+    out = cm.roofline(
+        {"flops": 1e9, "hbm_bytes": 1e9, "ici_bytes": 2e9,
+         "dcn_bytes": 1e9},
+        1.0, peaks,
+    )
+    assert out["achieved_ici_gbps"] == 2.0
+    assert out["achieved_dcn_gbps"] == 1.0
+    no_dcn = cm.roofline(
+        {"flops": 1e9, "hbm_bytes": 1e9, "ici_bytes": 2e9}, 1.0, peaks
+    )
+    assert "achieved_dcn_gbps" not in no_dcn
+
+
+def test_build_cost_record_measured_dcn_and_validator():
+    from boojum_tpu.prover.shape_key import shape_bucket
+    from tests.test_costmodel import STAGES, _fma_cfg_asm, _synthetic_tree
+
+    asm, cfg = _fma_cfg_asm()
+    sb = shape_bucket(asm, cfg)
+    walls = {nm: 0.5 for nm in STAGES}
+    peaks = {
+        "kind": "test", "peak_gflops": 100.0, "peak_hbm_gbps": 50.0,
+        "peak_ici_gbps": 10.0, "peak_dcn_gbps": 25.0, "source": "env",
+    }
+    metrics = {
+        "counters": {},
+        "gauges": {
+            "dcn.all_to_all_bytes": 1000.0,
+            "dcn.all_gather_bytes": 200.0,
+            "dcn.host_gather_bytes": 300.0,
+        },
+    }
+    rec = cm.build_cost_record(
+        sb, cfg, _synthetic_tree(walls), metrics, peaks=peaks,
+        mesh_devices=4, dcn_fraction=0.5,
+    )
+    assert rec["total"]["dcn_bytes_measured"] == 1500.0
+    assert rec["total"]["dcn_bytes"] > 0
+    assert rec["stages"]["round1_witness_commit"]["dcn_bytes"] > 0
+    assert report._validate_cost(rec, None) == []
+    bad = {**rec, "stages": dict(rec["stages"])}
+    bad["stages"]["round1_witness_commit"] = dict(
+        bad["stages"]["round1_witness_commit"], dcn_bytes=-1.0
+    )
+    assert any(
+        "dcn_bytes" in p for p in report._validate_cost(bad, None)
+    )
+
+
+def test_measured_baseline_covers_dcn_gauges():
+    reg = M.MetricsRegistry()
+    tok = M.install_scoped_registry(reg)
+    try:
+        M.count_ici_all_to_all(100.0, 40.0)
+        M.count_dcn_host_gather(10.0)
+        base = cm.measured_baseline()
+    finally:
+        M.reset_scoped_registry(tok)
+    assert base["gauges"]["dcn.all_to_all_bytes"] == 40.0
+    assert base["gauges"]["dcn.host_gather_bytes"] == 10.0
+
+
+# ---------------------------------------------------------------------------
+# report line validator
+# ---------------------------------------------------------------------------
+
+
+def _minimal_report(counters, gauges):
+    return {
+        "kind": report.REPORT_KIND,
+        "schema": report.REPORT_SCHEMA,
+        "wall_s": 0.5,
+        "spans": [],
+        "metrics": {"counters": counters, "gauges": gauges},
+        "checkpoints": [],
+    }
+
+
+def test_validator_accepts_dcn_only_crossing_bytes():
+    """A 1-local-device-per-host mesh moves ALL crossing bytes over DCN:
+    a counted all_to_all with zero ici bytes but positive dcn bytes must
+    pass (and vice versa keeps passing)."""
+    rep = _minimal_report(
+        {"ici.all_to_alls": 2},
+        {
+            "ici.all_to_all_bytes": 0.0,
+            "dcn.all_to_all_bytes": 512.0,
+            "ici.pivot_s": 0.01,
+        },
+    )
+    assert report.validate_report(rep) == []
+
+
+def test_validator_rejects_counted_dcn_without_bytes():
+    rep = _minimal_report(
+        {"dcn.host_gathers": 1}, {"dcn.host_gather_bytes": 0.0}
+    )
+    assert any(
+        "dcn.host_gather_bytes" in p for p in report.validate_report(rep)
+    )
+    neg = _minimal_report({}, {"dcn.all_gather_bytes": -4.0})
+    assert any(
+        "dcn.all_gather_bytes" in p for p in report.validate_report(neg)
+    )
+
+
+def test_validator_still_rejects_zero_byte_collectives():
+    rep = _minimal_report(
+        {"ici.all_to_alls": 1},
+        {"ici.all_to_all_bytes": 0.0, "ici.pivot_s": 0.01},
+    )
+    assert any(
+        "all_to_all_bytes" in p for p in report.validate_report(rep)
+    )
+
+
+# ---------------------------------------------------------------------------
+# fleet: per-host dcn column
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_host_entry_and_render_carry_dcn():
+    h0 = [{
+        "pid": 0,
+        "proofs": {},
+        "clock_sync": {"barrier_unix_ts": 100.0},
+        "ici": {"ici.all_to_all_bytes": 1e6, "ici.all_to_alls": 3},
+        "dcn": {"dcn.all_to_all_bytes": 2e6, "dcn.all_to_alls": 3},
+        "mesh_mode": "shard_map",
+    }]
+    h1 = [{
+        "kind": report.REPORT_KIND,
+        "schema": report.REPORT_SCHEMA,
+        "wall_s": 1.0,
+        "spans": [],
+        "metrics": {
+            "counters": {},
+            "gauges": {
+                "ici.all_gather_bytes": 5e5,
+                "dcn.all_gather_bytes": 7e5,
+                "dcn.host_gather_bytes": 1e5,
+            },
+        },
+        "checkpoints": [],
+    }]
+    rec = report.fleet_merge([("host0", h0), ("host1", h1)])
+    assert report.validate_fleet(rec) == []
+    hosts = {h["host"]: h for h in rec["hosts"]}
+    assert hosts["host0"]["dcn_bytes"] == 2e6
+    assert hosts["host0"]["ici_bytes"] == 1e6
+    assert hosts["host0"]["mesh_mode"] == "shard_map"
+    assert hosts["host1"]["dcn_bytes"] == 8e5
+    text = report.render_fleet(rec)
+    assert "dcn_MB" in text
+    assert "2.00" in text  # host0's 2e6 B column
+
+    bad = {**rec, "hosts": [dict(rec["hosts"][0], dcn_bytes=-1.0)]}
+    bad["n_hosts"] = 1
+    assert any("dcn_bytes" in p for p in report.validate_fleet(bad))
+
+
+# ---------------------------------------------------------------------------
+# trend: dcn:* byte series gate lower-is-better
+# ---------------------------------------------------------------------------
+
+
+def test_trend_learns_dcn_series_and_gates_regressions():
+    def _point(label, nbytes):
+        rep = _minimal_report(
+            {"ici.all_to_alls": 1},
+            {
+                "ici.all_to_all_bytes": 10.0,
+                "ici.pivot_s": 0.01,
+                "dcn.all_to_all_bytes": float(nbytes),
+            },
+        )
+        return {
+            "label": label,
+            "identity": "hostA",
+            "values": report._point_values_from_report(rep),
+        }
+
+    points = [
+        _point("r1", 1e6), _point("r2", 1.1e6), _point("r3", 2e6)
+    ]
+    series = report.trend_series(points)
+    key = ("hostA", "dcn:all_to_all_bytes")
+    assert key in series and series[key]["unit"] == "B"
+    regs = report.trend_gate(series, threshold=0.2)
+    assert any(r["series"] == "dcn:all_to_all_bytes" for r in regs)
+    # sub-1KiB wobble on a tiny series is noise, not a regression
+    tiny = report.trend_series(
+        [_point("r1", 100), _point("r2", 100), _point("r3", 400)]
+    )
+    assert not report.trend_gate(tiny, threshold=0.2)
+
+
+def test_trend_bench_line_dcn_dict():
+    line = {
+        "metric": "multichip_prove_wall",
+        "value": 2.0,
+        "unit": "s",
+        "dcn": {"dcn.all_to_all_bytes": 5e5, "dcn.all_to_alls": 3},
+    }
+    vals = report._point_values_from_bench(line)
+    assert vals["dcn:all_to_all_bytes"] == {"value": 5e5, "unit": "B"}
+    assert "dcn:all_to_alls" not in vals
+
+
+# ---------------------------------------------------------------------------
+# AOT fingerprint: process topology keys
+# ---------------------------------------------------------------------------
+
+
+def test_platform_info_keys_process_topology():
+    from boojum_tpu.prover import aot
+
+    info = aot.platform_info()
+    assert info["num_local_devices"] >= 1
+    assert info["process_count"] == 1
+    # the legacy global count stays stamped (report identity consumers)
+    assert info["num_devices"] >= info["num_local_devices"]
+    for k in ("num_local_devices", "process_count"):
+        assert k in aot._PLATFORM_FIELDS
+    assert "num_devices" not in aot._PLATFORM_FIELDS
