@@ -1031,6 +1031,14 @@ def prove(assembly, setup, config: ProofConfig, mesh=None) -> Proof:
     caller that already installed a FlightRecorder (bench.py labels its
     reps) keeps ownership — no double emission.
 
+    Trace context (ISSUE 17): the auto-installed recorder adopts
+    whatever inbound trace the execution context carries — the proving
+    service binds a gateway-minted context before dispatch, and a bare
+    CLI/bench prove honors BOOJUM_TPU_TRACE="<trace_id>[:<span_id>]"
+    (utils/spans.py inbound_trace) — so the emitted line's `trace_ctx`
+    and every span id stitch into the caller's distributed timeline;
+    without either, the recorder mints a fresh root trace.
+
     AOT artifacts: with BOOJUM_TPU_AOT_DIR=<dir> the prove consults the
     artifact store (prover/aot.py) BEFORE tracing — once per process per
     (shape bucket, variant) the pre-built executable bundle is installed

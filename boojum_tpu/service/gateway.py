@@ -65,6 +65,7 @@ from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..utils import report as _report
+from ..utils import spans as _spans
 from ..utils.profiling import log as _log
 from .http_metrics import MetricsPlane
 from .queue import LANES, QueueFullError
@@ -140,6 +141,10 @@ class GatewayJob:
     idem_key: str | None = None
     spooled: bool = False
     created_ts: float = 0.0
+    trace_id: str | None = None   # the trace minted (or honored from
+    #                               X-Boojum-Trace) at POST /prove
+    admit_span_id: str | None = None  # the admission root span — the
+    #                               parent every downstream span chains to
 
     def status(self) -> str:
         if self.spooled:
@@ -403,6 +408,21 @@ class Gateway:
         if tenant is None:
             self._count("service.gateway.auth_failures")
             return self._json(401, {"error": "unknown or missing token"})
+        # the trace is minted HERE, at the system's front door (ISSUE
+        # 17): an inbound X-Boojum-Trace header ("<trace_id>" or
+        # "<trace_id>:<parent_span_id>", ids as in BASELINE.md "Trace
+        # protocol") is honored so an external driver can stitch our
+        # timeline into its own; anything malformed is replaced, never
+        # propagated. The admission span id becomes the parent of every
+        # downstream span — queue wait, prove stages, spool write.
+        hdr = str(headers.get("X-Boojum-Trace") or "")
+        in_tid, _, in_psid = hdr.partition(":")
+        trace_id = (
+            in_tid if _spans.valid_trace_id(in_tid)
+            else _spans.new_trace_id()
+        )
+        admit_span_id = _spans.new_span_id()
+        trace_ctx = {"trace_id": trace_id, "parent_span_id": admit_span_id}
         # idempotency FIRST: a replay is a LEDGER READ — it must return
         # the original ticket before draining/quotas/shedding get a
         # chance to answer differently, and must never re-prove. The
@@ -439,6 +459,7 @@ class Gateway:
             job = GatewayJob(
                 id=job_id, tenant=tenant.id, spec={}, idem_key=idem,
                 created_ts=time.time(),
+                trace_id=trace_id, admit_span_id=admit_span_id,
             )
             self._jobs[job_id] = job
             if idem is not None:
@@ -470,7 +491,9 @@ class Gateway:
         if not ok:
             self._unreserve(job)
             self._count("service.gateway.throttled")
-            self._reject_line(tenant.id, "throttled", 429, retry_after)
+            self._reject_line(
+                tenant.id, "throttled", 429, retry_after, trace_ctx
+            )
             return self._json(
                 429,
                 {
@@ -483,7 +506,7 @@ class Gateway:
         if priority == "bulk" and self._should_shed():
             self._unreserve(job)
             self._count("service.gateway.shed")
-            self._reject_line(tenant.id, "shed", 503, None)
+            self._reject_line(tenant.id, "shed", 503, None, trace_ctx)
             return self._json(
                 503,
                 {"error": "bulk lane shedding load", "tenant": tenant.id},
@@ -491,7 +514,12 @@ class Gateway:
             )
 
         if priority == "bulk" and self.config.spool_dir:
-            nbytes = self._spool_job(job, tenant, spec)
+            admit_parent = (
+                in_psid if _spans.valid_span_id(in_psid) else None
+            )
+            nbytes = self._spool_job(
+                job, tenant, spec, trace_ctx, admit_parent
+            )
             # spooled work never reaches _serve_one's settle, so the
             # byte quota is charged HERE (spool-file bytes; the fleet
             # owns the compute) — without this a quota tenant could
@@ -502,7 +530,9 @@ class Gateway:
                 pass
             self._count("service.gateway.spooled")
             self._gc_jobs()
-            return self._json(202, self._ticket(job))
+            return self._json(
+                202, self._ticket(job), {"X-Boojum-Trace": trace_id}
+            )
         try:
             asm, setup, cfg = self.resolver(spec)
         except Exception as e:  # noqa: BLE001 — a spec the resolver
@@ -517,6 +547,7 @@ class Gateway:
                 request_id=job_id,
                 capture_trace=bool(spec.get("capture_trace")),
                 gateway=True,
+                trace=trace_ctx,
             )
         except QueueFullError:
             self._unreserve(job)
@@ -530,7 +561,9 @@ class Gateway:
             job.req = req
         self._count("service.gateway.admitted")
         self._gc_jobs()
-        return self._json(202, self._ticket(job))
+        return self._json(
+            202, self._ticket(job), {"X-Boojum-Trace": trace_id}
+        )
 
     def _unreserve(self, job: GatewayJob):
         """Roll a rejected admission's ticket/idempotency reservation
@@ -580,29 +613,99 @@ class Gateway:
             "status": job.status(),
             "priority": job.spec.get("priority", "batch"),
         }
+        if job.trace_id:
+            out["trace"] = job.trace_id
         if job.req is not None and job.req.done():
             out["request"] = dict(job.req.slo)
             if job.req.error is not None:
                 out["error"] = repr(job.req.error)
         return out
 
-    def _spool_job(self, job: GatewayJob, tenant, spec):
+    def _spool_job(self, job, tenant, spec, trace_ctx, admit_parent=None):
         """Farm a bulk job out to the worker fleet: one JSON file per
         request in the spool dir (atomic tmp+rename), named by job id so
-        spool order is admission order."""
+        spool order is admission order. The record carries the trace
+        context so a fleet worker's prove joins the gateway's trace
+        instead of orphaning (ISSUE 17 / ROADMAP item 3), and the write
+        itself is recorded as a span in a gateway report line — the
+        spooled job's footprint in THIS host's artifact."""
         record = dict(spec)
         record["job"] = job.id
         record["tenant"] = tenant.id
+        record["trace"] = dict(trace_ctx)
         path = os.path.join(self.config.spool_dir, f"{job.id}.json")
         tmp = path + ".tmp"
         payload = json.dumps(record)
+        t0 = time.perf_counter()
         with open(tmp, "w") as f:
             f.write(payload)
         os.replace(tmp, path)
+        wall = round(time.perf_counter() - t0, 6)
         with self._lock:
             job.spec = spec
             job.spooled = True
+        self._spool_line(job, tenant, payload, wall, trace_ctx, admit_parent)
         return len(payload)
+
+    def _spool_line(
+        self, job, tenant, payload, wall, trace_ctx, admit_parent
+    ):
+        """One gateway report line per spooled job: the admission root
+        span (the id every downstream span chains to) with the
+        spool-write as its child."""
+        rpath = self.service.report_path
+        if not rpath:
+            return
+        admit_span = {
+            "name": "gateway.admit",
+            "start_s": 0.0,
+            "wall_s": wall,
+            "span_id": job.admit_span_id or _spans.new_span_id(),
+            "trace_id": trace_ctx["trace_id"],
+            "children": [],
+            "attrs": {"job": job.id, "tenant": tenant.id, "spooled": True},
+        }
+        if admit_parent:
+            admit_span["parent_span_id"] = admit_parent
+        admit_span["children"].append(
+            {
+                "name": "gateway.spool_write",
+                "start_s": 0.0,
+                "wall_s": wall,
+                "span_id": _spans.new_span_id(),
+                "parent_span_id": admit_span["span_id"],
+                "children": [],
+                "attrs": {"job": job.id, "bytes": len(payload)},
+            }
+        )
+        line = {
+            "kind": _report.REPORT_KIND,
+            "schema": _report.REPORT_SCHEMA,
+            "label": "gateway:spool",
+            "unix_ts": round(time.time(), 3),
+            "wall_s": wall,
+            "spans": [admit_span],
+            "metrics": {
+                "counters": {"service.gateway.spooled": 1},
+                "gauges": {},
+            },
+            "checkpoints": [],
+            # the LINE's context is the external one: this line contains
+            # the admission span itself, so its parent is the inbound
+            # header's span (if any), not the admission span
+            "trace_ctx": (
+                {"trace_id": trace_ctx["trace_id"],
+                 "parent_span_id": admit_parent}
+                if admit_parent
+                else {"trace_id": trace_ctx["trace_id"]}
+            ),
+            "tenant": {"id": tenant.id, "charged_bytes": len(payload)},
+        }
+        try:
+            with self.service._report_lock:
+                _report.append_jsonl(rpath, line)
+        except Exception as e:  # noqa: BLE001
+            _log(f"gateway: spool line write failed: {e!r}")
 
     def _should_shed(self) -> bool:
         """Telemetry-driven load-shed: bulk work is rejected while queue
@@ -625,11 +728,15 @@ class Gateway:
                 return True
         return False
 
-    def _reject_line(self, tenant_id, reason, code, retry_after):
+    def _reject_line(self, tenant_id, reason, code, retry_after,
+                     trace_ctx=None):
         """Append a minimal report line for a rejected admission so the
         artifact carries the 429/shed history `--slo` aggregates. The
         line has NO request record (nothing was proved — --check
-        enforces that a rejected line never carries a prove wall)."""
+        enforces that a rejected line never carries a prove wall) but
+        DOES carry the trace context: a throttled request is part of
+        its trace's story, and --check fails a gateway line without
+        one."""
         path = self.service.report_path
         if not path:
             return
@@ -650,6 +757,8 @@ class Gateway:
             "checkpoints": [],
             "tenant": tenant_rec,
         }
+        if isinstance(trace_ctx, dict) and trace_ctx.get("trace_id"):
+            line["trace_ctx"] = dict(trace_ctx)
         try:
             with self.service._report_lock:
                 _report.append_jsonl(path, line)

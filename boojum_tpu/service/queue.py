@@ -119,6 +119,11 @@ class AdmissionQueue:
                     f"{self.rejects} rejects so far"
                 )
             request.admit_ts = time.perf_counter()
+            # trace-context admission stamp (ISSUE 17): the depth this
+            # request queued BEHIND — the queue.wait span's key attr,
+            # turning "the wait was long" into "the wait was long
+            # because N requests were ahead"
+            request.admit_depth = self._depth
             tenants = self._lanes[lane]
             if tenant not in tenants:
                 # a newly-active tenant joins at the ring's TAIL with

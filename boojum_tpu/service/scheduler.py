@@ -59,6 +59,11 @@ class Placement:
     #                            denominator — proof-parallel placements
     #                            carry mesh=None, so it rides here)
     reason: str = ""
+    trace_id: str | None = None  # the trace this decision serves (set
+    #                              when every request in the drain batch
+    #                              shares one — ISSUE 17): batch-level
+    #                              warm spans stamp it so the timeline
+    #                              stitcher can join them to the trace
 
     @property
     def occupancy(self) -> float:
@@ -98,12 +103,15 @@ def choose_placement(
     mesh,
     max_inflight: int = 1,
     threshold_rows: int | None = None,
+    trace_id: str | None = None,
 ) -> Placement:
     """Pick the placement for one request (or drain batch) of `bucket`.
 
     `occupancy` is the bucket's queued-request count (admission queue),
     `mesh` the service's mesh (None on a single chip — everything is
-    proof-parallel then)."""
+    proof-parallel then). `trace_id` threads the batch's propagated
+    trace context through the decision (rides the Placement so the warm
+    span downstream can stamp it)."""
     if threshold_rows is None:
         threshold_rows = shard_threshold_rows()
     n_dev = _mesh_devices(mesh)
@@ -114,6 +122,7 @@ def choose_placement(
                 f"trace 2^{bucket.log_n} >= shard threshold "
                 f"{threshold_rows} rows: one proof across {n_dev} chips"
             ),
+            trace_id=trace_id,
         )
     pack = max(1, min(occupancy, max_inflight, n_dev))
     return Placement(
@@ -123,6 +132,7 @@ def choose_placement(
             f"bucket occupancy {occupancy}: meshless proofs"
             + (f" packed {pack}-wide" if pack > 1 else "")
         ),
+        trace_id=trace_id,
     )
 
 
@@ -176,9 +186,14 @@ class VariantWarmer:
             placement.mesh if placement.kind == SHARD_PARALLEL else None
         )
         t0 = time.perf_counter()
-        with _span(
-            "service_warm_variant", shape=bucket.key, placement=placement.kind
-        ):
+        # batch-level work runs OUTSIDE any request's scoped recorder;
+        # the explicit trace attr is how a warm span recorded by a
+        # process-global recorder still joins the batch's trace in the
+        # stitched timeline (report._timeline_line_events)
+        warm_attrs = {"shape": bucket.key, "placement": placement.kind}
+        if placement.trace_id:
+            warm_attrs["trace"] = placement.trace_id
+        with _span("service_warm_variant", **warm_attrs):
             aot_stats = None
             if self.mode == "full":
                 from ..prover import aot as _aot

@@ -57,6 +57,7 @@ from dataclasses import dataclass, field
 from ..prover.shape_key import shape_bucket
 from ..utils import metrics as _metrics
 from ..utils import report as _report
+from ..utils import spans as _spans
 from ..utils.profiling import log as _log
 from ..utils.spans import span as _span
 from .cache import DeviceCacheManager
@@ -139,6 +140,11 @@ class ProveRequest:
     bucket_key: str = ""
     submit_ts: float = 0.0
     admit_ts: float = 0.0
+    admit_depth: int = 0           # queue depth waited behind (queue.py)
+    trace: dict | None = None      # propagated trace context (ISSUE 17):
+    #                                {"trace_id", "parent_span_id"?} —
+    #                                minted at submit unless the gateway
+    #                                handed one down
     proof: object = None
     error: BaseException | None = None
     slo: dict = field(default_factory=dict)
@@ -259,6 +265,7 @@ class ProvingService:
         request_id: str | None = None,
         capture_trace: bool = False,
         gateway: bool = False,
+        trace: dict | None = None,
     ) -> ProveRequest:
         """Admit one job (raises QueueFullError at the queue bound —
         the caller's backpressure signal). Shape bucketing happens here,
@@ -276,10 +283,23 @@ class ProvingService:
             capture_trace=capture_trace,
             gateway=gateway,
         )
+        # trace context from admission onward (ISSUE 17): adopt the
+        # caller's context (the gateway minted one at POST /prove, the
+        # fleet worker read one from its spool file) or mint a fresh
+        # root trace — every request is stitchable either way
+        if isinstance(trace, dict) and _spans.valid_trace_id(
+            trace.get("trace_id")
+        ):
+            req.trace = {"trace_id": trace["trace_id"]}
+            psid = trace.get("parent_span_id")
+            if _spans.valid_span_id(psid):
+                req.trace["parent_span_id"] = psid
+        else:
+            req.trace = {"trace_id": _spans.new_trace_id()}
         req.bucket = shape_bucket(assembly, config)
         req.bucket_key = req.bucket.key
         req.submit_ts = time.perf_counter()
-        self.queue.submit(req)  # stamps admit_ts
+        self.queue.submit(req)  # stamps admit_ts + admit_depth
         return req
 
     # ---- serving ---------------------------------------------------------
@@ -473,12 +493,23 @@ class ProvingService:
     def _serve_batch(self, batch: list) -> int:
         bucket = batch[0].bucket
         occupancy = len(batch) + self.queue.occupancy(bucket.key)
+        # the batch's trace context reaches the placement decision when
+        # every request shares one trace (the common single-request
+        # drain; a mixed batch stays trace-less at batch level — each
+        # request still records under its own trace)
+        batch_tids = {
+            (req.trace or {}).get("trace_id") for req in batch
+        }
+        batch_tid = (
+            batch_tids.pop() if len(batch_tids) == 1 else None
+        )
         placement = choose_placement(
             bucket,
             occupancy,
             self.mesh,
             max_inflight=self.config.max_inflight,
             threshold_rows=self.config.shard_threshold_rows,
+            trace_id=batch_tid,
         )
         _log(
             f"service: batch of {len(batch)} x {bucket.key} -> "
@@ -530,55 +561,79 @@ class ProvingService:
         owns recording and prove()'s process-global fallback never
         fires under packing.)"""
         path = self.report_path
-        if not path:
-            ok = self._run_request(req, placement, packed=packed,
-                                   device=device)
-            # quota is settled even without a report artifact — a
-            # metered tenant's window must fill either way
-            self._charge_quota(req)
-            return ok
-        with _report.flight_recording(
-            label=f"service:{req.id}", scoped=True
-        ) as rec:
-            try:
+        # bind the request's propagated trace to THIS execution context
+        # before any recorder exists: the scoped SpanRecorder the
+        # flight_recording below constructs adopts it, so the line's
+        # trace_ctx and every span id chain back to the gateway's
+        # admission span (ISSUE 17)
+        trace_tok = _spans.set_inbound_trace(req.trace)
+        try:
+            if not path:
                 ok = self._run_request(req, placement, packed=packed,
                                        device=device)
-            finally:
-                # the request record rides the ProveReport line even
-                # when the prove raised — a failed request's partial
-                # spans + SLO fields are the post-mortem
+                # quota is settled even without a report artifact — a
+                # metered tenant's window must fill either way
+                self._charge_quota(req)
+                return ok
+            with _report.flight_recording(
+                label=f"service:{req.id}", scoped=True
+            ) as rec:
+                # the queue.wait span (ISSUE 17 satellite): the
+                # admission→dispatch gap as a REAL backdated span, not
+                # just the queue_latency_s scalar — recorded here, not
+                # in _run_request, so it anchors the line even when the
+                # prove itself fails early
+                if req.admit_ts:
+                    sp = rec.spans.open(
+                        "queue.wait",
+                        start_at=req.admit_ts,
+                        request=req.id,
+                        lane=req.priority,
+                        depth=req.admit_depth,
+                    )
+                    rec.spans.close(sp)
                 try:
-                    extra = {"request": dict(req.slo)}
-                    tenant_rec = self._charge_quota(req, rec)
-                    if tenant_rec is not None:
-                        extra["tenant"] = tenant_rec
-                    line = _report.build_report(rec, extra=extra)
-                    # the request line must carry THIS service's time
-                    # series (queue depth, lane occupancy, in-flight) —
-                    # build_report read the process-global sampler slot,
-                    # which a bench harness may own with a provider-less
-                    # sampler of its own. Only rebuild when the slot is
-                    # foreign/empty; in the normal posture build_report
-                    # already snapshotted this very sampler.
-                    from ..utils import telemetry as _telemetry
+                    ok = self._run_request(req, placement, packed=packed,
+                                           device=device)
+                finally:
+                    # the request record rides the ProveReport line even
+                    # when the prove raised — a failed request's partial
+                    # spans + SLO fields are the post-mortem
+                    try:
+                        extra = {"request": dict(req.slo)}
+                        tenant_rec = self._charge_quota(req, rec)
+                        if tenant_rec is not None:
+                            extra["tenant"] = tenant_rec
+                        line = _report.build_report(rec, extra=extra)
+                        # the request line must carry THIS service's time
+                        # series (queue depth, lane occupancy, in-flight) —
+                        # build_report read the process-global sampler slot,
+                        # which a bench harness may own with a provider-less
+                        # sampler of its own. Only rebuild when the slot is
+                        # foreign/empty; in the normal posture build_report
+                        # already snapshotted this very sampler.
+                        from ..utils import telemetry as _telemetry
 
-                    if (
-                        self.sampler.ticks
-                        and _telemetry.current_sampler() is not self.sampler
-                    ):
-                        line["telemetry"] = self.sampler.snapshot()
-                    with self._report_lock:
-                        _report.append_jsonl(path, line)
-                except Exception as e:  # noqa: BLE001 — recording must
-                    # never turn a served proof into a failure
-                    _log(f"service: report write failed: {e!r}")
-                try:
-                    # the scoped registry dies with this block: fold it
-                    # into the service-lifetime one so /metrics keeps
-                    # the prove counter families
-                    self.prove_registry.fold(rec.metrics)
-                except Exception:  # noqa: BLE001
-                    pass
+                        if (
+                            self.sampler.ticks
+                            and _telemetry.current_sampler()
+                            is not self.sampler
+                        ):
+                            line["telemetry"] = self.sampler.snapshot()
+                        with self._report_lock:
+                            _report.append_jsonl(path, line)
+                    except Exception as e:  # noqa: BLE001 — recording must
+                        # never turn a served proof into a failure
+                        _log(f"service: report write failed: {e!r}")
+                    try:
+                        # the scoped registry dies with this block: fold it
+                        # into the service-lifetime one so /metrics keeps
+                        # the prove counter families
+                        self.prove_registry.fold(rec.metrics)
+                    except Exception:  # noqa: BLE001
+                        pass
+        finally:
+            _spans.reset_inbound_trace(trace_tok)
         return ok
 
     def _charge_quota(self, req: ProveRequest, rec=None) -> dict | None:
@@ -656,6 +711,8 @@ class ProvingService:
             "queue_latency_s": round(queue_latency, 6),
             "cache_hit": hit,
         }
+        if isinstance(req.trace, dict) and req.trace.get("trace_id"):
+            req.slo["trace_id"] = req.trace["trace_id"]
         if req.gateway:
             # gateway-admitted: --check requires the line to carry a
             # tenant record alongside this flag

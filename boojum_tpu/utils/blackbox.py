@@ -138,21 +138,23 @@ def _rss_kb() -> int | None:
         return None
 
 
-def _open_span_path(rec) -> str | None:
-    """The innermost still-open span of `rec`, as a /-joined path
-    ("prove/round3_quotient"). Reads the sanitized tree() snapshot —
-    open spans surface there with error="unclosed" — so the heartbeat
-    thread never touches the recorder's thread-local stack."""
+def _open_span(rec) -> tuple[str | None, str | None]:
+    """The innermost still-open span of `rec`: its /-joined path
+    ("prove/round3_quotient") AND its span_id (ISSUE 17: incidents join
+    the stitched timeline through the id). Reads the sanitized tree()
+    snapshot — open spans surface there with error="unclosed" — so the
+    heartbeat thread never touches the recorder's thread-local stack."""
     if rec is None:
-        return None
+        return None, None
     try:
         roots = rec.tree()
     except Exception:
-        return None
+        return None, None
     best: list[str] | None = None
+    best_sp: dict | None = None
 
     def _walk(sp, path):
-        nonlocal best
+        nonlocal best, best_sp
         path = path + [sp.get("name", "?")]
         open_here = sp.get("error") == "unclosed"
         deeper = False
@@ -162,11 +164,19 @@ def _open_span_path(rec) -> str | None:
         if open_here and not deeper:
             if best is None or len(path) > len(best):
                 best = path
+                best_sp = sp
         return open_here or deeper
 
     for r in roots:
         _walk(r, [])
-    return "/".join(best) if best else None
+    if best is None:
+        return None, None
+    sid = best_sp.get("span_id") if isinstance(best_sp, dict) else None
+    return "/".join(best), sid if isinstance(sid, str) else None
+
+
+def _open_span_path(rec) -> str | None:
+    return _open_span(rec)[0]
 
 
 def _ledger_fields() -> dict:
@@ -336,9 +346,17 @@ class BlackBox:
         }
         if self.label:
             rec["label"] = self.label
-        sp = _open_span_path(_spans.current_recorder())
+        srec = _spans.current_recorder()
+        sp, sid = _open_span(srec)
         if sp is not None:
             rec["span"] = sp
+        if sid is not None:
+            rec["span_id"] = sid
+        # trace stamp: the live recorder's trace ties every beat and
+        # stall/SIGTERM dump to the request it interrupted
+        tid = getattr(srec, "trace_id", None)
+        if isinstance(tid, str) and _spans.valid_trace_id(tid):
+            rec["trace_id"] = tid
         return rec
 
     def heartbeat(self) -> dict:

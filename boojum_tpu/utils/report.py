@@ -39,9 +39,20 @@ REPORT_KIND = "boojum_tpu.prove_report"
 # (ISSUE 12): lines may carry a `cost` record (utils/costmodel.py —
 # per-stage analytic flops/bytes joined with measured walls into
 # achieved GFLOP/s & GB/s, roofline regime and efficiency-vs-peak);
-# older-schema lines remain valid for --check/--diff
-REPORT_SCHEMA = 3
-ACCEPTED_SCHEMAS = (1, 2, 3)
+# schema 4 (ISSUE 17): every line carries a `trace_ctx` record
+# ({"trace_id": 32-hex, "parent_span_id"?: 16-hex}) and every span a
+# `span_id` (utils/spans.py) — the distributed-tracing plane
+# `prove_report.py --timeline` stitches on. Older-schema lines remain
+# valid for --check/--diff.
+REPORT_SCHEMA = 4
+ACCEPTED_SCHEMAS = (1, 2, 3, 4)
+
+# id formats (BASELINE.md "Trace protocol"). Re-declared here rather
+# than imported from utils/spans.py because report.py must stay
+# loadable standalone (scripts/prove_report.py file-loads it with no
+# package, no jax).
+TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
+SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
 
 # black-box forensics records (utils/blackbox.py): heartbeat/dump lines
 # interleave with prove lines in the same JSONL artifact; fleet records
@@ -251,6 +262,17 @@ def build_report(rec: FlightRecorder, extra: dict | None = None) -> dict:
         "metrics": rec.metrics.to_dict(),
         "checkpoints": list(rec.checkpoints.entries),
     }
+    # trace context (schema 4): the recorder's Dapper-style identity —
+    # adopted from the gateway/spool/env when this line serves a
+    # propagated trace, freshly minted otherwise. Either way every line
+    # is stitchable; `--check` fails a gateway line without it.
+    tid = getattr(rec.spans, "trace_id", None)
+    if isinstance(tid, str) and TRACE_ID_RE.match(tid):
+        tctx = {"trace_id": tid}
+        psid = getattr(rec.spans, "parent_span_id", None)
+        if isinstance(psid, str) and SPAN_ID_RE.match(psid):
+            tctx["parent_span_id"] = psid
+        d["trace_ctx"] = tctx
     if rec.trace_dir:
         d["trace"] = {"dir": rec.trace_dir}
     if getattr(rec, "cost", None):
@@ -428,6 +450,7 @@ def validate_report(report: dict) -> list[str]:
     # packed requests — the corruption mode the contextvar scoping
     # exists to prevent, so it must fail the gate loudly.
     span_request_ids = set()
+    line_span_ids: dict = {}
     for path, sp in _walk_spans(report.get("spans", ())):
         attrs = sp.get("attrs")
         if isinstance(attrs, dict) and attrs.get("request") is not None:
@@ -436,8 +459,44 @@ def validate_report(report: dict) -> list[str]:
         if not isinstance(w, (int, float)) or w < 0:
             problems.append(f"span {'/'.join(path)}: wall_s invalid: {w!r}")
         st = sp.get("start_s")
-        if not isinstance(st, (int, float)) or st < 0:
+        # a `backdated` span (queue.wait — utils/spans.py) legitimately
+        # starts before its recorder's t0, i.e. at a negative offset
+        if not isinstance(st, (int, float)) or (
+            st < 0 and not sp.get("backdated")
+        ):
             problems.append(f"span {'/'.join(path)}: start_s invalid: {st!r}")
+        # span identity (schema 4): ids must be well-formed and unique
+        # within the line — a collision means two spans would stitch
+        # into the same timeline node
+        sid = sp.get("span_id")
+        if sid is not None or (
+            isinstance(report.get("schema"), int) and report["schema"] >= 4
+        ):
+            if not (isinstance(sid, str) and SPAN_ID_RE.match(sid)):
+                problems.append(
+                    f"span {'/'.join(path)}: span_id malformed: {sid!r}"
+                )
+            elif sid in line_span_ids:
+                problems.append(
+                    f"span {'/'.join(path)}: span_id {sid} collides with "
+                    f"span {line_span_ids[sid]}"
+                )
+            else:
+                line_span_ids[sid] = "/".join(path)
+        psid = sp.get("parent_span_id")
+        if psid is not None and not (
+            isinstance(psid, str) and SPAN_ID_RE.match(psid)
+        ):
+            problems.append(
+                f"span {'/'.join(path)}: parent_span_id malformed: {psid!r}"
+            )
+        stid = sp.get("trace_id")
+        if stid is not None and not (
+            isinstance(stid, str) and TRACE_ID_RE.match(stid)
+        ):
+            problems.append(
+                f"span {'/'.join(path)}: trace_id malformed: {stid!r}"
+            )
         for c in sp.get("children", ()):
             cst = c.get("start_s")
             if (
@@ -727,6 +786,42 @@ def validate_report(report: dict) -> list[str]:
             f"{sorted(span_request_ids)}: scoped collectors bled "
             "across packed requests"
         )
+    # trace context (schema 4, ISSUE 17): when present it must be
+    # well-formed, and a GATEWAY line (an admitted request or a
+    # gateway-authored reject/spool line) must carry it at all — an
+    # orphan gateway trace defeats the entire propagation chain, so it
+    # fails the gate rather than silently dropping off timelines.
+    tctx = report.get("trace_ctx")
+    if tctx is not None:
+        if not isinstance(tctx, dict):
+            problems.append(
+                f"trace_ctx malformed: {type(tctx).__name__}"
+            )
+        else:
+            tid = tctx.get("trace_id")
+            if not (isinstance(tid, str) and TRACE_ID_RE.match(tid)):
+                problems.append(f"trace_ctx trace_id malformed: {tid!r}")
+            psid = tctx.get("parent_span_id")
+            if psid is not None and not (
+                isinstance(psid, str) and SPAN_ID_RE.match(psid)
+            ):
+                problems.append(
+                    f"trace_ctx parent_span_id malformed: {psid!r}"
+                )
+    is_gateway_line = bool(
+        (isinstance(request, dict) and request.get("gateway"))
+        or str(report.get("label") or "").startswith("gateway")
+    )
+    if (
+        tctx is None
+        and is_gateway_line
+        and isinstance(report.get("schema"), int)
+        and report["schema"] >= 4
+    ):
+        problems.append(
+            "gateway line missing trace_ctx: the admission that minted "
+            "the trace failed to propagate it"
+        )
     # telemetry record (schema 2, utils/telemetry.py): the background
     # sampler's time series. Samples must be time-ordered with finite
     # non-negative readings — a sampler writing junk would poison every
@@ -918,6 +1013,18 @@ def validate_blackbox(rec: dict) -> list[str]:
         isinstance(rec["span"], str) and rec["span"]
     ):
         problems.append(f"span invalid: {rec['span']!r}")
+    # trace stamps (ISSUE 17): incidents join the timeline by carrying
+    # the live recorder's trace id and the innermost OPEN span's id
+    tid = rec.get("trace_id")
+    if tid is not None and not (
+        isinstance(tid, str) and TRACE_ID_RE.match(tid)
+    ):
+        problems.append(f"trace_id malformed: {tid!r}")
+    sid = rec.get("span_id")
+    if sid is not None and not (
+        isinstance(sid, str) and SPAN_ID_RE.match(sid)
+    ):
+        problems.append(f"span_id malformed: {sid!r}")
     if record != "dump":
         return problems
     reason = rec.get("reason")
@@ -954,6 +1061,31 @@ def validate_blackbox(rec: dict) -> list[str]:
                 problems.append(f"dump heartbeat {i} malformed")
     if "spans" in rec and not isinstance(rec["spans"], list):
         problems.append("dump spans malformed")
+    # the dump's span path and span_id name the SAME span: both were
+    # read from the live tree the dump also embeds. A disagreement means
+    # the forensics raced the recorder and the dump's attribution cannot
+    # be trusted — reject it rather than let an incident pin the wrong
+    # stage.
+    if (
+        isinstance(sid, str)
+        and SPAN_ID_RE.match(sid)
+        and isinstance(rec.get("span"), str)
+        and isinstance(rec.get("spans"), list)
+    ):
+        found = None
+        for path, sp in _walk_spans(rec["spans"]):
+            if sp.get("span_id") == sid:
+                found = "/".join(path)
+                break
+        if found is None:
+            problems.append(
+                f"dump span_id {sid} not present in the embedded span tree"
+            )
+        elif found != rec["span"]:
+            problems.append(
+                f"dump span path {rec['span']!r} disagrees with span_id "
+                f"{sid} (tree says {found!r})"
+            )
     return problems
 
 
@@ -1057,6 +1189,33 @@ def validate_line(doc: dict) -> list[str]:
     if kind == FLEET_KIND:
         return validate_fleet(doc)
     return validate_report(doc)
+
+
+def validate_artifact(docs: list) -> list[str]:
+    """Cross-LINE invariants over a whole artifact (the per-line checks
+    are validate_line): span ids must be unique across every prove
+    line's span tree — two lines sharing a span_id would stitch into
+    one timeline node and silently merge two requests' history. Only
+    REPORT_KIND trees define ids; blackbox dumps EMBED a snapshot of a
+    live tree whose spans reappear in that recorder's final line, so
+    they are references, not definitions."""
+    problems: list[str] = []
+    seen: dict = {}
+    for i, d in enumerate(docs):
+        if not isinstance(d, dict) or d.get("kind") != REPORT_KIND:
+            continue
+        for path, sp in _walk_spans(d.get("spans") or ()):
+            sid = sp.get("span_id")
+            if not (isinstance(sid, str) and SPAN_ID_RE.match(sid)):
+                continue
+            key = f"line {i} span {'/'.join(path)}"
+            if sid in seen:
+                problems.append(
+                    f"span_id {sid} collides: {seen[sid]} vs {key}"
+                )
+            else:
+                seen[sid] = key
+    return problems
 
 
 def _sum_gauges(metrics: dict, prefixes: tuple, contains: str) -> float | None:
@@ -1297,6 +1456,532 @@ def render_fleet(rec: dict) -> str:
     if not rec.get("stragglers"):
         lines.append("no stragglers")
     return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Distributed-trace timeline (ISSUE 17) — pure dict functions; the
+# `prove_report.py --timeline` payoff surface. Merge N per-host
+# artifacts, align their clocks with the same barrier stamps fleet_merge
+# uses, stitch spans into per-trace event lists, and render either an
+# ASCII swimlane or Chrome trace-event JSON loadable in Perfetto.
+# ---------------------------------------------------------------------------
+
+TIMELINE_KIND = "boojum_tpu.timeline"
+TIMELINE_SCHEMAS = (1,)
+# bucket for events whose line predates schema 4 (or lost its context):
+# still rendered, clearly labeled as unstitched
+UNTRACED = "untraced"
+
+
+def _timeline_line_events(label: str, d: dict, off: float) -> list:
+    """Flatten one ProveReport line's span tree into absolute-time span
+    events. The line's `unix_ts` is stamped when the recorder CLOSES,
+    so the recording started at unix_ts - wall_s; each span sits at its
+    start_s offset from there (queue.wait's negative, backdated start
+    lands it before the recording window — exactly where the wait
+    happened). `off` is the host's barrier-derived clock offset."""
+    unix_ts, wall = d.get("unix_ts"), d.get("wall_s")
+    if not (
+        isinstance(unix_ts, (int, float)) and isinstance(wall, (int, float))
+    ):
+        return []
+    t0_abs = float(unix_ts) - float(wall) - off
+    line_tid = (d.get("trace_ctx") or {}).get("trace_id")
+    out: list = []
+
+    def _walk(sp, tid):
+        if not isinstance(sp, dict):
+            return
+        if isinstance(sp.get("trace_id"), str):
+            tid = sp["trace_id"]
+        attrs = sp.get("attrs") or {}
+        # batch-scoped work (scheduler warm spans) recorded OUTSIDE the
+        # request's scoped recorder joins the trace via an explicit
+        # `trace` attr stamped by the scheduler
+        a_tid = attrs.get("trace")
+        if isinstance(a_tid, str) and TRACE_ID_RE.match(a_tid):
+            tid = a_tid
+        start, w = sp.get("start_s"), sp.get("wall_s")
+        if isinstance(start, (int, float)) and isinstance(w, (int, float)):
+            ev = {
+                "name": sp.get("name"),
+                "host": label,
+                "label": d.get("label"),
+                "trace_id": tid,
+                "span_id": sp.get("span_id"),
+                "parent_span_id": sp.get("parent_span_id"),
+                "t_s": round(t0_abs + float(start), 6),
+                "wall_s": float(w),
+            }
+            for k in ("sync_s", "overlap_s", "error"):
+                if k in sp:
+                    ev[k] = sp[k]
+            out.append(ev)
+        for c in sp.get("children") or ():
+            _walk(c, tid)
+
+    for root in d.get("spans") or ():
+        _walk(root, line_tid)
+    return out
+
+
+def _timeline_line_counters(label: str, d: dict, off: float) -> list:
+    """Telemetry samples as absolute-time counter points (Perfetto "C"
+    tracks). Needs the sampler's `t0_unix_ts` anchor (schema 4,
+    utils/telemetry.py) — samples only carry monotonic offsets."""
+    tele = d.get("telemetry")
+    if not isinstance(tele, dict):
+        return []
+    anchor = tele.get("t0_unix_ts")
+    if not isinstance(anchor, (int, float)):
+        return []
+    out = []
+    for s in tele.get("samples") or ():
+        if not isinstance(s, dict):
+            continue
+        t = s.get("t_s")
+        if not isinstance(t, (int, float)):
+            continue
+        ts = round(float(anchor) + float(t) - off, 6)
+        for k, v in s.items():
+            if k == "t_s" or not isinstance(v, (int, float)):
+                continue
+            out.append({"host": label, "name": k, "t_s": ts, "value": v})
+    return out
+
+
+def _timeline_blackbox_event(label: str, d: dict, off: float):
+    """A heartbeat/dump line as an instant event: incidents join the
+    timeline via the trace/open-span ids the blackbox stamps."""
+    unix_ts = d.get("unix_ts")
+    if not isinstance(unix_ts, (int, float)):
+        return None
+    record = d.get("record")
+    name = f"blackbox.{record}"
+    if record == "dump" and d.get("reason"):
+        name = f"blackbox.{d['reason']}"
+    ev = {
+        "instant": record,
+        "name": name,
+        "host": label,
+        "t_s": round(float(unix_ts) - off, 6),
+    }
+    for k in ("trace_id", "span_id", "span", "phase", "reason"):
+        if d.get(k):
+            ev[k] = d[k]
+    return ev
+
+
+def timeline_merge(
+    host_docs: list,
+    straggler_ratio: float = 1.5,
+    min_abs_s: float = 0.05,
+) -> dict:
+    """Stitch per-host artifacts into ONE timeline record. `host_docs`
+    is [(label, [parsed lines...]), ...] — report JSONL, multihost
+    result lines, blackbox sidecars, in any mix.
+
+    Clock alignment: identical to fleet_merge — hosts that stamped a
+    barrier-synchronized `clock_sync.barrier_unix_ts` all passed the
+    same collective at the same instant, so stamp differences ARE the
+    skews; every host's events shift by its offset from the earliest
+    host. Without two stamped hosts, events stay on raw wall clocks
+    (noted in `clock`).
+
+    Straggler rule (per trace): a span name appearing on >= 2 hosts
+    flags its slowest host when it exceeds straggler_ratio x the
+    across-host median by at least min_abs_s."""
+    stamps: dict = {}
+    for lbl, docs in host_docs:
+        for d in docs:
+            if not isinstance(d, dict):
+                continue
+            cs = d.get("clock_sync")
+            if isinstance(cs, dict) and isinstance(
+                cs.get("barrier_unix_ts"), (int, float)
+            ):
+                stamps[lbl] = float(cs["barrier_unix_ts"])
+    if len(stamps) >= 2:
+        t0c = min(stamps.values())
+        offsets = {h: round(s - t0c, 6) for h, s in stamps.items()}
+        clock = {
+            "method": "barrier",
+            "max_skew_s": round(max(stamps.values()) - t0c, 6),
+        }
+    else:
+        offsets = {}
+        clock = {
+            "method": "none",
+            "note": (
+                "fewer than 2 hosts carry clock_sync.barrier_unix_ts; "
+                "events are on raw per-host wall clocks"
+            ),
+        }
+    events: list = []
+    marks: list = []
+    counters: list = []
+    for lbl, docs in host_docs:
+        off = offsets.get(lbl, 0.0)
+        for d in docs:
+            if not isinstance(d, dict):
+                continue
+            kind = d.get("kind")
+            if kind == REPORT_KIND:
+                events.extend(_timeline_line_events(lbl, d, off))
+                counters.extend(_timeline_line_counters(lbl, d, off))
+            elif kind == BLACKBOX_KIND:
+                ev = _timeline_blackbox_event(lbl, d, off)
+                if ev is not None:
+                    events.append(ev)
+            elif "pid" in d and isinstance(d.get("clock_sync"), dict):
+                ts = d["clock_sync"].get("barrier_unix_ts")
+                if isinstance(ts, (int, float)):
+                    # aligned barrier instants from every host coincide
+                    # by construction — the visual proof the alignment
+                    # worked when loaded in Perfetto
+                    marks.append(
+                        {
+                            "instant": "clock_sync",
+                            "name": "clock_sync.barrier",
+                            "host": lbl,
+                            "t_s": round(float(ts) - off, 6),
+                        }
+                    )
+    # telemetry snapshots overlap across lines from the same sampler —
+    # dedupe counter points on (host, series, timestamp)
+    seen_pts = set()
+    uniq_counters = []
+    for c in counters:
+        key = (c["host"], c["name"], c["t_s"])
+        if key not in seen_pts:
+            seen_pts.add(key)
+            uniq_counters.append(c)
+    counters = sorted(uniq_counters, key=lambda c: c["t_s"])
+    # group into per-trace event lists; instants without a trace id are
+    # global marks
+    by_trace: dict = {}
+    for ev in events:
+        tid = ev.get("trace_id")
+        if not tid and ev.get("instant"):
+            marks.append(ev)
+            continue
+        by_trace.setdefault(tid or UNTRACED, []).append(ev)
+    traces: list = []
+    all_stragglers: list = []
+    for tid, evs in by_trace.items():
+        evs.sort(key=lambda e: (e["t_s"], -e.get("wall_s", 0.0)))
+        t0 = min(e["t_s"] for e in evs)
+        t1 = max(e["t_s"] + e.get("wall_s", 0.0) for e in evs)
+        span_evs = [e for e in evs if "wall_s" in e]
+        # per-name across-host straggler attribution within the trace
+        by_name: dict = {}
+        for e in span_evs:
+            walls = by_name.setdefault(e["name"], {})
+            walls[e["host"]] = max(walls.get(e["host"], 0.0), e["wall_s"])
+        stragglers = []
+        for nm in sorted(by_name):
+            walls = by_name[nm]
+            if len(walls) < 2:
+                continue
+            med = _percentile(sorted(walls.values()), 0.5)
+            max_host = max(walls, key=walls.get)
+            mx = walls[max_host]
+            if (
+                med > 0
+                and mx > med * straggler_ratio
+                and (mx - med) >= min_abs_s
+            ):
+                stragglers.append(
+                    {
+                        "span": nm,
+                        "host": max_host,
+                        "wall_s": round(mx, 6),
+                        "median_s": round(med, 6),
+                        "ratio": round(mx / med, 4),
+                    }
+                )
+                for e in span_evs:
+                    if (
+                        e["name"] == nm
+                        and e["host"] == max_host
+                        and e["wall_s"] == mx
+                    ):
+                        e["straggler"] = True
+        for s in stragglers:
+            all_stragglers.append(dict(s, trace_id=tid))
+        traces.append(
+            {
+                "trace_id": tid,
+                "t0_unix_ts": round(t0, 6),
+                "wall_s": round(t1 - t0, 6),
+                "hosts": sorted({e["host"] for e in evs}),
+                "n_spans": len(span_evs),
+                "n_instants": len(evs) - len(span_evs),
+                "events": evs,
+                "stragglers": stragglers,
+            }
+        )
+    # chronological, with the untraced bucket last
+    traces.sort(
+        key=lambda t: (t["trace_id"] == UNTRACED, t["t0_unix_ts"])
+    )
+    hosts = sorted({lbl for lbl, _docs in host_docs})
+    return {
+        "kind": TIMELINE_KIND,
+        "schema": TIMELINE_SCHEMAS[-1],
+        "unix_ts": time.time(),
+        "hosts": hosts,
+        "clock": clock,
+        "offsets": offsets,
+        "n_traces": len(traces),
+        "traces": traces,
+        "marks": sorted(marks, key=lambda m: m["t_s"]),
+        "counters": counters,
+        "stragglers": all_stragglers,
+    }
+
+
+def _event_depth(ev: dict, by_id: dict, limit: int = 12) -> int:
+    depth = 0
+    cur = ev
+    while depth < limit:
+        psid = cur.get("parent_span_id")
+        if not psid or psid not in by_id:
+            break
+        cur = by_id[psid]
+        depth += 1
+    return depth
+
+
+def render_timeline(rec: dict, width: int = 48, max_rows: int = 48) -> str:
+    """ASCII swimlane per trace: one row per span (indented by stitch
+    depth), a scaled `=` bar positioned in the trace's window, instants
+    as `!` markers, stragglers flagged."""
+    lines = []
+    clock = rec.get("clock") or {}
+    skew = clock.get("max_skew_s")
+    lines.append(
+        f"timeline: {len(rec.get('hosts') or ())} hosts, "
+        f"{rec.get('n_traces')} traces, clock={clock.get('method')}"
+        + (f" (max skew {skew}s)" if skew is not None else "")
+    )
+    if clock.get("note"):
+        lines.append(f"  note: {clock['note']}")
+    for off_host in sorted(rec.get("offsets") or {}):
+        lines.append(
+            f"  offset {off_host}: +{rec['offsets'][off_host]}s"
+        )
+    for tr in rec.get("traces") or ():
+        tid = tr.get("trace_id") or "?"
+        head = tid if tid == UNTRACED else tid[:8]
+        lines.append(
+            f"trace {head}: {len(tr.get('hosts') or ())} host(s), "
+            f"{tr.get('wall_s')}s, {tr.get('n_spans')} spans, "
+            f"{tr.get('n_instants')} instants"
+        )
+        evs = tr.get("events") or []
+        by_id = {
+            e["span_id"]: e for e in evs if e.get("span_id")
+        }
+        t0 = tr.get("t0_unix_ts", 0.0)
+        dur = max(tr.get("wall_s") or 0.0, 1e-9)
+        shown = evs[:max_rows]
+        for ev in shown:
+            sidx = int((ev["t_s"] - t0) / dur * width)
+            sidx = min(max(sidx, 0), width - 1)
+            if "wall_s" in ev:
+                slen = max(1, int(ev["wall_s"] / dur * width))
+                slen = min(slen, width - sidx)
+                bar = "." * sidx + "=" * slen
+                tail = f" {ev['wall_s']:.3f}s"
+            else:
+                bar = "." * sidx + "!"
+                tail = ""
+            bar = bar.ljust(width, ".")
+            depth = _event_depth(ev, by_id)
+            name = "  " * depth + str(ev.get("name"))
+            flag = ""
+            if ev.get("straggler"):
+                flag = " <- straggler"
+            if ev.get("error"):
+                flag += f" [error: {ev['error']}]"
+            lines.append(
+                f"  {ev.get('host', '?'):<12} {name:<28.28} "
+                f"[{bar}]{tail}{flag}"
+            )
+        if len(evs) > len(shown):
+            lines.append(f"  ... {len(evs) - len(shown)} more events")
+        for s in tr.get("stragglers") or ():
+            lines.append(
+                f"  straggler: {s['span']} on {s['host']} "
+                f"({s['wall_s']}s vs median {s['median_s']}s, "
+                f"x{s['ratio']})"
+            )
+    return "\n".join(lines)
+
+
+def perfetto_events(rec: dict) -> dict:
+    """A timeline record as Chrome trace-event JSON (the format Perfetto
+    and chrome://tracing load): hosts become processes, traces become
+    threads, spans become "X" complete events, dumps/heartbeats/barrier
+    marks become "i" instants, telemetry series become "C" counters.
+    Timestamps are microseconds from the earliest stitched event."""
+    traces = rec.get("traces") or []
+    marks = rec.get("marks") or []
+    counters = rec.get("counters") or []
+    all_ts = (
+        [e["t_s"] for tr in traces for e in tr.get("events") or ()]
+        + [m["t_s"] for m in marks]
+        + [c["t_s"] for c in counters]
+    )
+    base = min(all_ts) if all_ts else 0.0
+
+    def _us(t):
+        return round(max(t - base, 0.0) * 1e6, 3)
+
+    host_pid = {h: i + 1 for i, h in enumerate(rec.get("hosts") or ())}
+    out = []
+    for h, pid in host_pid.items():
+        out.append(
+            {
+                "ph": "M",
+                "name": "process_name",
+                "pid": pid,
+                "tid": 0,
+                "args": {"name": h},
+            }
+        )
+    for ti, tr in enumerate(traces):
+        tid_n = ti + 1
+        label = tr.get("trace_id") or "?"
+        if label != UNTRACED:
+            label = f"trace {label[:8]}"
+        for h in tr.get("hosts") or ():
+            out.append(
+                {
+                    "ph": "M",
+                    "name": "thread_name",
+                    "pid": host_pid.get(h, 0),
+                    "tid": tid_n,
+                    "args": {"name": label},
+                }
+            )
+        for ev in tr.get("events") or ():
+            pid = host_pid.get(ev.get("host"), 0)
+            args = {
+                k: ev[k]
+                for k in (
+                    "trace_id",
+                    "span_id",
+                    "parent_span_id",
+                    "host",
+                    "label",
+                    "sync_s",
+                    "overlap_s",
+                    "error",
+                    "straggler",
+                    "span",
+                    "phase",
+                    "reason",
+                )
+                if ev.get(k) is not None
+            }
+            if "wall_s" in ev:
+                out.append(
+                    {
+                        "name": str(ev.get("name")),
+                        "ph": "X",
+                        "cat": "span",
+                        "ts": _us(ev["t_s"]),
+                        "dur": round(max(ev["wall_s"], 0.0) * 1e6, 3),
+                        "pid": pid,
+                        "tid": tid_n,
+                        "args": args,
+                    }
+                )
+            else:
+                out.append(
+                    {
+                        "name": str(ev.get("name")),
+                        "ph": "i",
+                        "s": "t",
+                        "cat": "blackbox",
+                        "ts": _us(ev["t_s"]),
+                        "pid": pid,
+                        "tid": tid_n,
+                        "args": args,
+                    }
+                )
+    for m in marks:
+        out.append(
+            {
+                "name": str(m.get("name")),
+                "ph": "i",
+                "s": "p",
+                "cat": "mark",
+                "ts": _us(m["t_s"]),
+                "pid": host_pid.get(m.get("host"), 0),
+                "tid": 0,
+                "args": {
+                    k: m[k]
+                    for k in ("host", "span", "phase", "reason")
+                    if m.get(k) is not None
+                },
+            }
+        )
+    for c in counters:
+        out.append(
+            {
+                "name": str(c["name"]),
+                "ph": "C",
+                "ts": _us(c["t_s"]),
+                "pid": host_pid.get(c.get("host"), 0),
+                "tid": 0,
+                "args": {"value": c["value"]},
+            }
+        )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def validate_perfetto(doc: dict) -> list[str]:
+    """Sanity gate for emitted Chrome trace-event JSON (the ci_gate
+    --timeline leg's bar): a traceEvents list whose every event has a
+    name, a known phase, non-negative numeric timestamps, and — for
+    "X" complete events — a non-negative duration."""
+    problems: list[str] = []
+    if not isinstance(doc, dict) or not isinstance(
+        doc.get("traceEvents"), list
+    ):
+        return ["traceEvents missing"]
+    evs = doc["traceEvents"]
+    if not evs:
+        problems.append("traceEvents empty")
+    for i, ev in enumerate(evs):
+        if not isinstance(ev, dict):
+            problems.append(f"event {i}: not an object")
+            continue
+        if not (isinstance(ev.get("name"), str) and ev["name"]):
+            problems.append(f"event {i}: name missing")
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M", "C"):
+            problems.append(f"event {i}: ph invalid: {ph!r}")
+        if not isinstance(ev.get("pid"), int):
+            problems.append(f"event {i}: pid invalid: {ev.get('pid')!r}")
+        if ph == "M":
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts != ts or ts < 0:
+            problems.append(f"event {i}: ts invalid: {ts!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur != dur or dur < 0:
+                problems.append(f"event {i}: dur invalid: {dur!r}")
+        if ph == "i" and ev.get("s") not in ("t", "p", "g"):
+            problems.append(f"event {i}: instant scope invalid: {ev.get('s')!r}")
+        if len(problems) > 25:
+            problems.append("... (truncated)")
+            break
+    return problems
 
 
 def diff_reports(a: dict, b: dict, top: int = 10) -> dict:

@@ -28,6 +28,14 @@ Explicit device sync points: `sync_point(x, label)` calls
 `jax.block_until_ready` when an installed recorder asks for synced spans,
 charging asynchronously-dispatched device work to the stage that issued it
 instead of whichever later stage first touches the result.
+
+Trace context (ISSUE 17): every recorder owns a Dapper-style trace —
+a 32-hex `trace_id` minted at construction (or adopted from an inbound
+context bound via `set_inbound_trace` / the BOOJUM_TPU_TRACE env var),
+and every span opened under it carries a fresh 16-hex `span_id` plus a
+`parent_span_id` (the enclosing span's id; for roots, the inbound
+parent — e.g. the gateway's admission span). The ids are what
+`prove_report.py --timeline` stitches cross-host artifacts on.
 """
 
 from __future__ import annotations
@@ -35,10 +43,86 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import os
+import secrets
 import threading
 import time
 
 from . import profiling as _prof
+
+# Dapper-mold id formats (BASELINE.md "Trace protocol"): trace ids are
+# 128-bit, span ids 64-bit, both lowercase hex — the same widths the
+# W3C traceparent header uses, so external drivers can mint compatible
+# ids without knowing anything about this codebase.
+TRACE_ID_HEX = 32
+SPAN_ID_HEX = 16
+
+
+def new_trace_id() -> str:
+    return secrets.token_hex(TRACE_ID_HEX // 2)
+
+
+def new_span_id() -> str:
+    return secrets.token_hex(SPAN_ID_HEX // 2)
+
+
+def _is_hex_id(s, width: int) -> bool:
+    return (
+        isinstance(s, str)
+        and len(s) == width
+        and all(c in "0123456789abcdef" for c in s)
+    )
+
+
+def valid_trace_id(s) -> bool:
+    return _is_hex_id(s, TRACE_ID_HEX)
+
+
+def valid_span_id(s) -> bool:
+    return _is_hex_id(s, SPAN_ID_HEX)
+
+
+# inbound trace context: bound to the current execution context by
+# whoever dispatches work on behalf of an already-minted trace (the
+# proving service serving a gateway-admitted request). A SpanRecorder
+# constructed while a context is bound ADOPTS it instead of minting a
+# fresh trace — that is the whole propagation mechanism; nothing else
+# needs to know where the recorder came from.
+_INBOUND_TRACE: contextvars.ContextVar[dict | None] = contextvars.ContextVar(
+    "boojum_tpu.inbound_trace", default=None
+)
+
+
+def set_inbound_trace(ctx: dict | None):
+    """Bind an inbound trace context ({"trace_id": ..,
+    "parent_span_id": ..?}) to the CURRENT execution context; returns a
+    token for reset_inbound_trace. A malformed context is treated as
+    absent (recorders mint a fresh trace) rather than poisoning ids."""
+    if not (isinstance(ctx, dict) and valid_trace_id(ctx.get("trace_id"))):
+        ctx = None
+    return _INBOUND_TRACE.set(ctx)
+
+
+def reset_inbound_trace(token):
+    _INBOUND_TRACE.reset(token)
+
+
+def inbound_trace() -> dict | None:
+    """The trace context a new recorder should adopt: contextvar first,
+    then the BOOJUM_TPU_TRACE env var ("<trace_id>[:<parent_span_id>]")
+    — the latter lets an external driver hand a trace to a bare
+    `prove()` CLI/bench process without touching its code."""
+    ctx = _INBOUND_TRACE.get()
+    if ctx is not None:
+        return ctx
+    env = os.environ.get("BOOJUM_TPU_TRACE")
+    if env:
+        tid, _, psid = env.partition(":")
+        if valid_trace_id(tid):
+            out = {"trace_id": tid}
+            if valid_span_id(psid):
+                out["parent_span_id"] = psid
+            return out
+    return None
 
 
 class SpanRecorder:
@@ -53,6 +137,33 @@ class SpanRecorder:
         self.sync = sync
         self._tls = threading.local()
         self._lock = threading.Lock()
+        # trace context: adopt the inbound one when the constructing
+        # context carries it (the scoped-collector path — one gateway
+        # request on its pool thread), else mint a fresh root trace
+        ctx = inbound_trace()
+        if ctx is not None:
+            self.trace_id = ctx["trace_id"]
+            psid = ctx.get("parent_span_id")
+            self.parent_span_id = psid if valid_span_id(psid) else None
+        else:
+            self.trace_id = new_trace_id()
+            self.parent_span_id = None
+
+    def adopt_trace(self, trace_id: str, parent_span_id: str | None = None):
+        """Rebind this recorder (and any roots already opened) to an
+        externally-minted trace — for callers that learn the context
+        only after constructing the recorder."""
+        if not valid_trace_id(trace_id):
+            return
+        self.trace_id = trace_id
+        self.parent_span_id = (
+            parent_span_id if valid_span_id(parent_span_id) else None
+        )
+        with self._lock:
+            for r in self.roots:
+                r["trace_id"] = trace_id
+                if self.parent_span_id:
+                    r["parent_span_id"] = self.parent_span_id
 
     def _stack(self) -> list:
         st = getattr(self._tls, "stack", None)
@@ -64,24 +175,39 @@ class SpanRecorder:
         st = self._stack()
         return st[-1] if st else None
 
-    def open(self, name: str, **attrs) -> dict:
+    def open(self, name: str, start_at: float | None = None, **attrs) -> dict:
+        """Open a span. `start_at` (a time.perf_counter stamp) backdates
+        the span to an instant BEFORE open() ran — how the queue.wait
+        span covers the admission→dispatch gap even though the request's
+        scoped recorder is only constructed at dispatch. A backdated
+        span that predates the recorder itself carries a negative
+        start_s and a `backdated` marker so validation can tell it from
+        a corrupt clock."""
         now = time.perf_counter()
+        t0 = start_at if (start_at is not None and start_at <= now) else now
         sp: dict = {
             "name": name,
-            "start_s": round(now - self.t0, 6),
+            "start_s": round(t0 - self.t0, 6),
             "wall_s": None,
+            "span_id": new_span_id(),
             "children": [],
         }
+        if t0 < self.t0:
+            sp["backdated"] = True
         if attrs:
             sp["attrs"] = dict(attrs)
         st = self._stack()
         if st:
+            sp["parent_span_id"] = st[-1]["span_id"]
             st[-1]["children"].append(sp)
         else:
+            sp["trace_id"] = self.trace_id
+            if self.parent_span_id:
+                sp["parent_span_id"] = self.parent_span_id
             with self._lock:
                 self.roots.append(sp)
         st.append(sp)
-        sp["_t0"] = now
+        sp["_t0"] = t0
         return sp
 
     def close(self, sp: dict, error: str | None = None):

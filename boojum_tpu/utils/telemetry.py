@@ -80,6 +80,10 @@ class TelemetrySampler:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._t0 = time.perf_counter()
+        # the same instant on the wall clock: samples carry monotonic
+        # t_s offsets, so the timeline stitcher (ISSUE 17) needs this
+        # anchor to place them on the cross-host absolute axis
+        self.t0_unix_ts = round(time.time(), 6)
         self.ticks = 0
         self.provider_errors = 0
 
@@ -188,6 +192,7 @@ class TelemetrySampler:
         return {
             "interval_s": self.interval_s,
             "ticks": self.ticks,
+            "t0_unix_ts": self.t0_unix_ts,
             "samples": samples,
         }
 

@@ -15,11 +15,18 @@
 # multi-host shard_map). Slow: real CPU proves per process; not part
 # of the default invocation.
 #
+# With --timeline, a smoke leg drives the distributed-tracing export
+# (ISSUE 17): the gateway trace-propagation test produces a traced
+# artifact, prove_report.py --check gates it, --timeline --perfetto
+# exports Chrome trace-event JSON, and the leg fails when the JSON is
+# invalid or the queue-wait span went missing.
+#
 # Exits nonzero when any requested leg fails. Knobs:
 #   CI_GATE_TIMEOUT_S     tier-1 budget in seconds (default 870, as in
 #                         ROADMAP.md; the -k kill grace stays 10 s)
 #   CI_GATE_THRESHOLD     relative regression threshold (default 0.2)
 #   CI_GATE_MH_TIMEOUT_S  --multihost leg budget in seconds (default 3600)
+#   CI_GATE_TL_TIMEOUT_S  --timeline leg budget in seconds (default 300)
 set -u -o pipefail
 
 root="$(cd "$(dirname "$0")/.." && pwd)"
@@ -28,12 +35,16 @@ cd "$root"
 timeout_s="${CI_GATE_TIMEOUT_S:-870}"
 threshold="${CI_GATE_THRESHOLD:-0.2}"
 mh_timeout_s="${CI_GATE_MH_TIMEOUT_S:-3600}"
+tl_timeout_s="${CI_GATE_TL_TIMEOUT_S:-300}"
 multihost=0
+timeline=0
 for arg in "$@"; do
     case "$arg" in
         --multihost) multihost=1 ;;
+        --timeline) timeline=1 ;;
         *)
-            echo "ci_gate: unknown argument $arg (supported: --multihost)" >&2
+            echo "ci_gate: unknown argument $arg" \
+                 "(supported: --multihost --timeline)" >&2
             exit 2
             ;;
     esac
@@ -76,6 +87,49 @@ else
     else
         echo "ci_gate: perf trend gate ok"
     fi
+fi
+
+if [ "$timeline" -eq 1 ]; then
+    echo "== ci_gate: timeline export leg (budget ${tl_timeout_s}s) =="
+    tl_tmp="$(mktemp -d)"
+    # the trace-propagation test leaves its gateway artifact under the
+    # pytest basetemp; the CLI then stitches + exports it
+    timeout -k 10 "$tl_timeout_s" env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_gateway.py -q \
+        -k trace_propagation --basetemp "$tl_tmp/pytest" \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    tl_rc=$?
+    if [ "$tl_rc" -ne 0 ]; then
+        echo "ci_gate: timeline leg: trace-propagation test FAILED (rc=$tl_rc)"
+        rc=1
+    else
+        artifact="$(find "$tl_tmp/pytest" -name 'gw.jsonl' | head -n 1)"
+        if [ -z "$artifact" ]; then
+            echo "ci_gate: timeline leg: no gateway artifact produced"
+            rc=1
+        else
+            python scripts/prove_report.py --check "$artifact" \
+                && python scripts/prove_report.py --timeline "$artifact" \
+                       --perfetto "$tl_tmp/perfetto.json" \
+                && python - "$tl_tmp/perfetto.json" <<'PYEOF'
+import json, sys
+with open(sys.argv[1]) as f:
+    doc = json.load(f)
+evs = doc.get("traceEvents")
+assert isinstance(evs, list) and evs, "traceEvents missing/empty"
+names = {e.get("name") for e in evs}
+assert "queue.wait" in names, "queue.wait span missing from export"
+print(f"ci_gate: perfetto export ok ({len(evs)} events)")
+PYEOF
+            if [ $? -ne 0 ]; then
+                echo "ci_gate: timeline export leg FAILED"
+                rc=1
+            else
+                echo "ci_gate: timeline export leg ok"
+            fi
+        fi
+    fi
+    rm -rf "$tl_tmp"
 fi
 
 if [ "$multihost" -eq 1 ]; then

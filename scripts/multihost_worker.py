@@ -229,6 +229,11 @@ def main():
                 asm = build_circuit(int(spec.get("seed", 0))).into_assembly()
                 setup = generate_setup(asm, cfg)
                 priority = spec.get("priority", "bulk")
+                # trace propagation (ISSUE 17): the spool record carries
+                # the trace the GATEWAY minted at POST /prove — submit
+                # under it so the fleet's prove lines stitch back to the
+                # admission instead of orphaning
+                trace = spec.get("trace")
                 return svc.submit(
                     asm, setup, cfg,
                     request_id=str(spec.get("job", _fname)),
@@ -236,6 +241,7 @@ def main():
                     priority=priority if priority in (
                         "interactive", "batch", "bulk"
                     ) else "bulk",
+                    trace=trace if isinstance(trace, dict) else None,
                 )
 
             mine_spool = distribute_proofs(read_spool(spool_dir),
@@ -247,6 +253,15 @@ def main():
         for _i, req in mine:
             assert verify(req.setup.vk, req.result(), req.assembly.gates)
         result["proofs"] = {str(i): req.result().to_json() for i, req in mine}
+        # per-job trace ids on the result line (ISSUE 17): fleet-proved
+        # jobs must not be orphan traces — the gateway side joins its
+        # tickets to the fleet's proves through this map, and the
+        # timeline stitcher gets it for free via each prove line's
+        # trace_ctx
+        result["traces"] = {
+            req.id: (req.trace or {}).get("trace_id")
+            for _i, req in list(mine) + list(mine_spool)
+        }
         if mine_spool:
             for _i, req in mine_spool:
                 assert verify(

@@ -60,6 +60,20 @@ Usage:
       (checkable with --check). Exits 1 when the merged record fails
       its own validation.
 
+  python scripts/prove_report.py --timeline PATH [PATH...] [--perfetto F]
+      Stitch per-host artifacts (report .jsonl and/or multihost_worker
+      result files, same inputs as --fleet) into ONE distributed trace
+      timeline (ISSUE 17): host clocks are aligned via the
+      barrier-synchronized clock_sync stamps (no NTP assumption), spans
+      are grouped per trace_id into parent/child trees across hosts, and
+      an ASCII swimlane is rendered — queue.wait next to the prove
+      stages it delayed, blackbox instants pinned on the same axis, the
+      slowest host of every across-host span flagged as a straggler.
+      --perfetto additionally writes the merged timeline as Chrome
+      trace-event JSON (open in Perfetto / chrome://tracing); the export
+      is validated before writing and the command exits 1 when the
+      merge yields no events or the JSON fails validation.
+
   python scripts/prove_report.py --slo <report.jsonl>
       Aggregate the per-request SLO records of a proving-service
       artifact: p50/p95 queue latency and prove wall, proofs/sec over
@@ -246,6 +260,16 @@ def main(argv=None) -> int:
         help="with --fleet: also write the fleet record as JSON here",
     )
     ap.add_argument(
+        "--timeline", nargs="+", metavar="PATH",
+        help="stitch per-host artifacts into one clock-aligned "
+             "distributed-trace timeline (ASCII swimlane per trace)",
+    )
+    ap.add_argument(
+        "--perfetto", metavar="OUT_JSON",
+        help="with --timeline: also write the merged timeline as Chrome "
+             "trace-event JSON (Perfetto / chrome://tracing)",
+    )
+    ap.add_argument(
         "--index", type=int, default=-1,
         help="which JSONL line to use (default: last)",
     )
@@ -294,6 +318,14 @@ def main(argv=None) -> int:
                     f"{len(rep.get('checkpoints') or [])} checkpoints, "
                     f"span coverage {cov * 100:.1f}%"
                 )
+        # cross-line pass: a span_id shared by two report lines means the
+        # trace stitcher would merge unrelated spans — fail the artifact
+        cross = rl.validate_artifact(reports)
+        if cross:
+            bad += 1
+            print("artifact: INVALID")
+            for p in cross:
+                print(f"  - {p}")
         return 1 if bad else 0
 
     if args.fleet:
@@ -321,6 +353,38 @@ def main(argv=None) -> int:
             for p in problems:
                 print(f"  - {p}")
             return 1
+        return 0
+
+    if args.timeline:
+        host_docs = []
+        seen_tl: dict = {}
+        for p in args.timeline:
+            label, docs = _load_fleet_host(p)
+            if label in seen_tl:
+                seen_tl[label] += 1
+                label = f"{label}.{seen_tl[label]}"
+            else:
+                seen_tl[label] = 0
+            host_docs.append((label, docs))
+        rec = rl.timeline_merge(host_docs)
+        print(rl.render_timeline(rec))
+        if not rec.get("traces") and not rec.get("marks"):
+            print("timeline: no events — nothing to export")
+            return 1
+        if args.perfetto:
+            doc = rl.perfetto_events(rec)
+            problems = rl.validate_perfetto(doc)
+            if problems:
+                print("PERFETTO EXPORT INVALID:")
+                for p in problems:
+                    print(f"  - {p}")
+                return 1
+            with open(args.perfetto, "w") as f:
+                f.write(json.dumps(doc, sort_keys=True))
+            print(
+                f"perfetto trace ({len(doc['traceEvents'])} events) "
+                f"-> {args.perfetto}"
+            )
         return 0
 
     if args.slo:

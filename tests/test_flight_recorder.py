@@ -288,7 +288,8 @@ def test_validate_report_rejects_mixed_request_ids():
         "wall_s": 0.5,
         "spans": [
             {"name": "service_request", "start_s": 0.0, "wall_s": 0.1,
-             "children": [], "attrs": {"request": "req-1"}},
+             "span_id": "11" * 8, "children": [],
+             "attrs": {"request": "req-1"}},
         ],
         "metrics": {"counters": {}},
         "checkpoints": [],
@@ -301,7 +302,8 @@ def test_validate_report_rejects_mixed_request_ids():
     bad = dict(base)
     bad["spans"] = base["spans"] + [
         {"name": "service_request", "start_s": 0.2, "wall_s": 0.1,
-         "children": [], "attrs": {"request": "req-2"}},
+         "span_id": "22" * 8, "children": [],
+         "attrs": {"request": "req-2"}},
     ]
     probs = report.validate_report(bad)
     assert any("mixes request ids" in p for p in probs), probs
@@ -487,11 +489,15 @@ def test_prove_report_cli_subprocess_is_light():
                 "name": "prove",
                 "start_s": 0.0,
                 "wall_s": 1.0,
+                "span_id": "aa" * 8,
+                "trace_id": "ab" * 16,
                 "children": [
                     {
                         "name": "round1",
                         "start_s": 0.0,
                         "wall_s": 0.95,
+                        "span_id": "bb" * 8,
+                        "parent_span_id": "aa" * 8,
                         "children": [],
                     }
                 ],

@@ -226,6 +226,8 @@ def _line(**extra):
         "kind": report.REPORT_KIND, "schema": report.REPORT_SCHEMA,
         "label": "t", "wall_s": 0.1, "spans": [],
         "metrics": {"counters": {}}, "checkpoints": [],
+        # schema 4: gateway lines without a trace context fail --check
+        "trace_ctx": {"trace_id": "ef" * 16},
     }
     base.update(extra)
     return base
@@ -347,6 +349,8 @@ def _fake_run_request(self, req, placement, packed=1, device=None):
     }
     if req.gateway:
         req.slo["gateway"] = True
+    if req.trace:
+        req.slo["trace_id"] = req.trace["trace_id"]
     req.proof = _FakeProof(req.bucket_key)
     with self._stats_lock:
         self.stats["served"] += 1
@@ -697,17 +701,139 @@ def test_gateway_drain_and_reload_verbs(stub_gateway):
     assert counters["service.gateway.drains"] == 1
 
 
+def test_gateway_trace_propagation(stub_gateway):
+    """ISSUE 17 tentpole: the gateway mints ONE trace at POST /prove
+    (honoring an inbound X-Boojum-Trace header) and that id rides the
+    ticket, the response header, the request line's trace_ctx, the
+    queue.wait span, the 429 rejection line and the spool file — so a
+    single request's whole story stitches under one trace_id."""
+    gw, svc, rpt = stub_gateway
+    from boojum_tpu.service import read_spool
+    from boojum_tpu.utils import spans as spans_mod
+
+    tid = "ab" * 16
+    psid = "cd" * 8
+    traced_headers = {
+        "Authorization": "Bearer tok-alice",
+        "X-Boojum-Trace": f"{tid}:{psid}",
+    }
+    out = gw.handle("POST", "/prove", traced_headers, b"{}")
+    assert out[0] == 202
+    ticket = json.loads(out[1])
+    assert ticket["trace"] == tid
+    assert out[3]["X-Boojum-Trace"] == tid
+    # a header-less admission mints a fresh, distinct, well-formed id
+    code, t2, h2 = _post(gw, "/prove", token="tok-alice")
+    assert code == 202
+    assert spans_mod.valid_trace_id(t2["trace"]) and t2["trace"] != tid
+    assert h2["X-Boojum-Trace"] == t2["trace"]
+    svc.run_worker()
+
+    lines = report.load_reports(rpt)
+    req_lines = [ln for ln in lines if "request" in ln]
+    assert len(req_lines) == 2
+    by_tid = {ln["trace_ctx"]["trace_id"]: ln for ln in req_lines}
+    assert set(by_tid) == {tid, t2["trace"]}
+    for ln in req_lines:
+        # admission queueing is a REAL (backdated) span: queue.wait
+        # roots the line's tree, chained to the gateway's admit span
+        (qw,) = [sp for sp in ln["spans"] if sp["name"] == "queue.wait"]
+        assert report.SPAN_ID_RE.match(qw["span_id"])
+        assert qw["trace_id"] == ln["trace_ctx"]["trace_id"]
+        assert qw["parent_span_id"] == ln["trace_ctx"]["parent_span_id"]
+        assert qw["attrs"]["request"] == ln["request"]["id"]
+        assert ln["request"]["trace_id"] == ln["trace_ctx"]["trace_id"]
+
+    # bob's second request 429s AFTER his quota charge lands; the
+    # rejection line still tells the trace's story
+    assert _post(gw, "/prove", token="tok-bob")[0] == 202
+    svc.run_worker()
+    out = gw.handle(
+        "POST", "/prove",
+        {"Authorization": "Bearer tok-bob", "X-Boojum-Trace": tid}, b"{}",
+    )
+    assert out[0] == 429
+
+    # a spooled bulk job: the trace context rides the spool file for
+    # the fleet AND the admit span materializes in a gateway line
+    out = gw.handle(
+        "POST", "/prove", traced_headers,
+        json.dumps({"priority": "bulk"}).encode(),
+    )
+    assert out[0] == 202 and json.loads(out[1])["status"] == "spooled"
+    ((_fname, spooled),) = read_spool(gw.config.spool_dir)
+    assert spooled["trace"]["trace_id"] == tid
+
+    lines = report.load_reports(rpt)
+    rejects = [
+        ln for ln in lines if (ln.get("tenant") or {}).get("rejected")
+    ]
+    assert len(rejects) == 1
+    assert rejects[0]["trace_ctx"]["trace_id"] == tid
+    (spool_line,) = [
+        ln for ln in lines if ln.get("label") == "gateway:spool"
+    ]
+    (admit,) = spool_line["spans"]
+    assert admit["name"] == "gateway.admit"
+    assert admit["trace_id"] == tid
+    assert admit["parent_span_id"] == psid  # inbound header's parent
+    (sw,) = admit["children"]
+    assert sw["name"] == "gateway.spool_write"
+    assert sw["parent_span_id"] == admit["span_id"]
+    assert spool_line["trace_ctx"] == {
+        "trace_id": tid, "parent_span_id": psid,
+    }
+    # every line validates and NO span_id repeats across the artifact
+    for ln in lines:
+        assert report.validate_report(ln) == [], ln.get("label")
+    assert report.validate_artifact(lines) == []
+
+
+def test_gateway_line_trace_rules_fail_closed():
+    """--check's trace rules: a schema-4 gateway line WITHOUT trace_ctx
+    fails, and two report lines sharing a span_id fail the artifact."""
+    base = {
+        "kind": report.REPORT_KIND,
+        "schema": report.REPORT_SCHEMA,
+        "unix_ts": 1.0,
+        "wall_s": 0.0,
+        "spans": [],
+        "metrics": {"counters": {}, "gauges": {}},
+        "checkpoints": [],
+    }
+    naked = dict(base, label="gateway:throttled",
+                 tenant={"id": "t", "rejected": 429, "reason": "throttled"})
+    assert any(
+        "missing trace_ctx" in p for p in report.validate_report(naked)
+    )
+    assert report.validate_report(
+        dict(naked, trace_ctx={"trace_id": "ab" * 16})
+    ) == []
+    sp = {
+        "name": "s", "start_s": 0.0, "wall_s": 0.0,
+        "span_id": "11" * 8, "children": [],
+    }
+    a = dict(base, label="a", spans=[dict(sp)])
+    b = dict(base, label="b", spans=[dict(sp)])
+    assert report.validate_report(a) == []
+    probs = report.validate_artifact([a, b])
+    assert probs and "collides" in probs[0]
+
+
 # ---------------------------------------------------------------------------
 # Sockets: the error-counting satellite + the E2E acceptance run
 # ---------------------------------------------------------------------------
 
 
-def _http(url, method="GET", token=None, body=None, idem=None, timeout=30):
+def _http(url, method="GET", token=None, body=None, idem=None, timeout=30,
+          trace=None):
     headers = {"Content-Type": "application/json"}
     if token:
         headers["Authorization"] = f"Bearer {token}"
     if idem:
         headers["Idempotency-Key"] = idem
+    if trace:
+        headers["X-Boojum-Trace"] = trace
     req = urllib.request.Request(
         url, data=body, headers=headers, method=method
     )
@@ -805,12 +931,16 @@ def test_e2e_two_tenants_over_http(tmp_path):
     port = gw.start()
     base = f"http://127.0.0.1:{port}"
     try:
-        code, body, _ = _http(
+        e2e_tid = "5a" * 16  # a client-minted trace id, honored end to end
+        code, body, hdrs = _http(
             f"{base}/prove", "POST", token="tok-alice", body=b"{}",
-            idem="alice-req-1",
+            idem="alice-req-1", trace=e2e_tid,
         )
         assert code == 202
-        job_a1 = json.loads(body)["job"]
+        assert hdrs["X-Boojum-Trace"] == e2e_tid
+        ticket_a1 = json.loads(body)
+        assert ticket_a1["trace"] == e2e_tid
+        job_a1 = ticket_a1["job"]
         code, body, _ = _http(
             f"{base}/prove", "POST", token="tok-bob", body=b"{}"
         )
@@ -895,6 +1025,20 @@ def test_e2e_two_tenants_over_http(tmp_path):
                if (ln.get("tenant") or {}).get("rejected")]
     assert len(rejects) == 1 and rejects[0]["tenant"]["id"] == "bob"
 
+    # ISSUE 17 acceptance: ONE trace_id spans admission -> prove ->
+    # proof download — the client-minted id tags exactly alice's first
+    # request line, whose tree holds both the backdated queue.wait and
+    # the real prove stages, and no span_id repeats across the artifact
+    traced = [
+        ln for ln in req_lines
+        if (ln.get("trace_ctx") or {}).get("trace_id") == e2e_tid
+    ]
+    assert len(traced) == 1
+    tr_names = {name.split("/")[-1]
+                for name, _sp in report.flatten_spans(traced[0])}
+    assert "queue.wait" in tr_names and "prove" in tr_names
+    assert report.validate_artifact(lines) == []
+
     # the stdlib CLI gate agrees, end to end
     cli = os.path.join(REPO_ROOT, "scripts", "prove_report.py")
     env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}
@@ -910,3 +1054,19 @@ def test_e2e_two_tenants_over_http(tmp_path):
     assert slo.returncode == 0, slo.stdout + slo.stderr
     assert "tenant alice" in slo.stdout
     assert "throttled(429)=1" in slo.stdout
+
+    # --timeline stitches the artifact and the Perfetto export is valid
+    # trace-event JSON carrying the queue-wait and prove-stage spans
+    perfetto_out = str(tmp_path / "e2e_perfetto.json")
+    tl = subprocess.run(
+        [sys.executable, cli, "--timeline", rpt, "--perfetto",
+         perfetto_out],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert tl.returncode == 0, tl.stdout + tl.stderr
+    assert f"trace {e2e_tid[:8]}" in tl.stdout
+    with open(perfetto_out) as f:
+        doc = json.load(f)
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert "queue.wait" in names and "prove" in names
+    assert report.validate_perfetto(doc) == []

@@ -1,0 +1,333 @@
+"""Distributed-trace timeline stitching (ISSUE 17 tentpole).
+
+Everything here is synthetic and stdlib-only: the report library is
+loaded standalone (by file path, the same way scripts/prove_report.py
+does) so these tests never import jax and run in milliseconds. Covered:
+
+- two-host merge with INJECTED clock skew: barrier-derived offsets,
+  collective span ordering after alignment (the skewed host's events
+  land where they actually happened, the aligned barrier marks
+  coincide), and the across-host straggler flagged per trace;
+- the Perfetto (Chrome trace-event JSON) export validates and carries
+  the queue-wait span, the stitched instants and the counter tracks;
+- --check's trace rules fail closed: backdated negative starts pass
+  only when flagged, a dump whose span path disagrees with its span_id
+  is rejected, colliding span_ids fail the artifact;
+- the prove_report.py CLI drives the whole path end to end.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_rl():
+    path = os.path.join(REPO_ROOT, "boojum_tpu", "utils", "report.py")
+    spec = importlib.util.spec_from_file_location("_tl_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+rl = _load_rl()
+
+TID = "ab" * 16
+SKEW_S = 7.0
+
+
+def _span(name, start_s, wall_s, span_id, parent=None, trace=None, **extra):
+    sp = {
+        "name": name,
+        "start_s": start_s,
+        "wall_s": wall_s,
+        "span_id": span_id,
+        "children": [],
+    }
+    if parent:
+        sp["parent_span_id"] = parent
+    if trace:
+        sp["trace_id"] = trace
+    sp.update(extra)
+    return sp
+
+
+def _line(label, unix_ts, wall_s, spans, **extra):
+    d = {
+        "kind": rl.REPORT_KIND,
+        "schema": rl.REPORT_SCHEMA,
+        "label": label,
+        "unix_ts": unix_ts,
+        "wall_s": wall_s,
+        "spans": spans,
+        "metrics": {"counters": {}, "gauges": {}},
+        "checkpoints": [],
+        "trace_ctx": {"trace_id": TID},
+    }
+    d.update(extra)
+    return d
+
+
+def _result_line(pid, barrier_ts):
+    return {
+        "pid": pid,
+        "process_count": 2,
+        "clock_sync": {"barrier_unix_ts": barrier_ts},
+    }
+
+
+def _two_host_docs():
+    """Two hosts proving one trace. host1's wall clock runs SKEW_S fast
+    (its barrier stamp reads later), its spans carry raw timestamps on
+    that fast clock, and its msm collective is a genuine straggler."""
+    # host0: recorder closed at unix 1010 after a 10 s window -> t0 1000
+    qw = _span("queue.wait", -0.5, 0.4, "11" * 8, parent="aa" * 8,
+               trace=TID, backdated=True)
+    prove0 = _span("prove", 0.0, 10.0, "22" * 8, trace=TID)
+    prove0["children"].append(
+        _span("msm", 1.0, 2.0, "33" * 8, parent="22" * 8)
+    )
+    host0 = [
+        _result_line(0, 1000.0),
+        _line("service:r-1", 1010.0, 10.0, [qw, prove0]),
+    ]
+    # host1: same work, stamps SKEW_S later on its fast clock; aligned,
+    # its prove starts 2 s after host0's (1002), not 9 s (1009 raw)
+    prove1 = _span("prove", 0.0, 10.0, "44" * 8, trace=TID)
+    prove1["children"].append(
+        _span("msm", 1.0, 8.0, "55" * 8, parent="44" * 8)
+    )
+    dump = {
+        "kind": rl.BLACKBOX_KIND,
+        "schema": rl.BLACKBOX_SCHEMAS[-1],
+        "record": "dump",
+        "reason": "stall",
+        "unix_ts": 1012.0 + SKEW_S,
+        "trace_id": TID,
+        "span_id": "55" * 8,
+        "span": "prove/msm",
+    }
+    host1 = [
+        _result_line(1, 1000.0 + SKEW_S),
+        _line("service:r-2", 1012.0 + SKEW_S, 10.0, [prove1]),
+        dump,
+    ]
+    return [("host0", host0), ("host1", host1)]
+
+
+def test_two_host_merge_aligns_skewed_clocks_and_flags_straggler():
+    rec = _two_host_docs()
+    merged = rl.timeline_merge(rec)
+    assert merged["kind"] == rl.TIMELINE_KIND
+    assert merged["clock"]["method"] == "barrier"
+    assert merged["clock"]["max_skew_s"] == SKEW_S
+    assert merged["offsets"] == {"host0": 0.0, "host1": SKEW_S}
+    # the aligned barrier instants coincide by construction
+    barrier_ts = {
+        m["t_s"] for m in merged["marks"]
+        if m["name"] == "clock_sync.barrier"
+    }
+    assert barrier_ts == {1000.0}
+    (tr,) = merged["traces"]
+    assert tr["trace_id"] == TID
+    assert tr["hosts"] == ["host0", "host1"]
+    evs = {(e["host"], e["name"]): e for e in tr["events"]
+           if "wall_s" in e}
+    # host0's backdated queue.wait sits BEFORE its recording window
+    assert evs[("host0", "queue.wait")]["t_s"] == 999.5
+    # collective ordering survives the skew: host1's prove started 2 s
+    # after host0's on the shared clock, not 9 s as raw stamps claim
+    assert evs[("host0", "prove")]["t_s"] == 1000.0
+    assert evs[("host1", "prove")]["t_s"] == 1002.0
+    # the slow msm on host1 (8 s vs 2 s median pair) is the straggler
+    (st,) = tr["stragglers"]
+    assert st["span"] == "msm" and st["host"] == "host1"
+    assert evs[("host1", "msm")]["straggler"] is True
+    assert "msm" in [s["span"] for s in merged["stragglers"]]
+    # the blackbox dump joined the trace as an instant event
+    instants = [e for e in tr["events"] if "wall_s" not in e]
+    assert instants and instants[0]["name"] == "blackbox.stall"
+    assert instants[0]["t_s"] == 1012.0  # skew removed
+    # the swimlane names the straggler
+    text = rl.render_timeline(merged)
+    assert "straggler" in text and TID[:8] in text
+
+
+def test_merge_without_barrier_stamps_stays_on_raw_clocks():
+    (lbl, docs), _ = _two_host_docs()
+    merged = rl.timeline_merge([(lbl, docs)])
+    assert merged["clock"]["method"] == "none"
+    assert merged["offsets"] == {}
+    assert merged["n_traces"] == 1
+
+
+def test_untraced_lines_bucket_last():
+    host = [
+        _line("old", 900.0, 1.0, [
+            {"name": "legacy", "start_s": 0.0, "wall_s": 1.0,
+             "children": []},
+        ]),
+        _line("new", 1010.0, 10.0, [
+            _span("prove", 0.0, 10.0, "22" * 8, trace=TID),
+        ]),
+    ]
+    host[0].pop("trace_ctx")
+    merged = rl.timeline_merge([("host0", host)])
+    assert [t["trace_id"] for t in merged["traces"]] == [TID, rl.UNTRACED]
+
+
+def test_perfetto_export_validates_and_carries_the_story():
+    docs = _two_host_docs()
+    # a telemetry series rides host0's line as counter tracks
+    docs[0][1][1]["telemetry"] = {
+        "t0_unix_ts": 1000.5,
+        "samples": [
+            {"t_s": 0.0, "host_rss_bytes": 5.0},
+            {"t_s": 1.0, "host_rss_bytes": 6.0},
+        ],
+    }
+    doc = rl.perfetto_events(rl.timeline_merge(docs))
+    assert rl.validate_perfetto(doc) == []
+    evs = doc["traceEvents"]
+    procs = {e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert procs == {"host0", "host1"}
+    spans = {e["name"] for e in evs if e["ph"] == "X"}
+    assert {"queue.wait", "prove", "msm"} <= spans
+    (stall,) = [e for e in evs if e["name"] == "blackbox.stall"]
+    assert stall["ph"] == "i" and stall["s"] == "t"
+    counters = [e for e in evs if e["ph"] == "C"]
+    assert len(counters) == 2
+    assert min(e["ts"] for e in evs if e["ph"] != "M") == 0.0
+    straggler_args = [
+        e["args"].get("straggler") for e in evs
+        if e["ph"] == "X" and e["name"] == "msm"
+        and e["args"]["host"] == "host1"
+    ]
+    assert straggler_args == [True]
+
+
+def test_validate_perfetto_rejects_garbage():
+    assert rl.validate_perfetto({}) == ["traceEvents missing"]
+    assert "traceEvents empty" in rl.validate_perfetto({"traceEvents": []})
+    bad = {"traceEvents": [{"name": "x", "ph": "X", "pid": 1,
+                            "ts": -1.0, "dur": 1.0}]}
+    assert any("ts invalid" in p for p in rl.validate_perfetto(bad))
+    bad = {"traceEvents": [{"name": "x", "ph": "Z", "pid": 1, "ts": 0.0}]}
+    assert any("ph invalid" in p for p in rl.validate_perfetto(bad))
+
+
+def test_backdated_negative_start_passes_only_when_flagged():
+    flagged = _line("svc", 1010.0, 10.0, [
+        _span("queue.wait", -0.5, 0.4, "11" * 8, trace=TID,
+              backdated=True),
+    ])
+    assert rl.validate_report(flagged) == []
+    unflagged = _line("svc", 1010.0, 10.0, [
+        _span("queue.wait", -0.5, 0.4, "11" * 8, trace=TID),
+    ])
+    assert any(
+        "start_s" in p for p in rl.validate_report(unflagged)
+    )
+
+
+def test_validate_report_rejects_malformed_trace_fields():
+    bad_tid = _line("svc", 1.0, 1.0, [])
+    bad_tid["trace_ctx"] = {"trace_id": "xyz"}
+    assert any("trace_ctx" in p for p in rl.validate_report(bad_tid))
+    dup = _line("svc", 1.0, 1.0, [
+        _span("a", 0.0, 1.0, "11" * 8, trace=TID),
+        _span("b", 0.0, 1.0, "11" * 8, trace=TID),
+    ])
+    assert any("span_id" in p for p in rl.validate_report(dup))
+
+
+def _dump(span_path, span_id, spans):
+    hb = {
+        "kind": rl.BLACKBOX_KIND, "schema": 1, "record": "heartbeat",
+        "seq": 1, "t_s": 1.0, "unix_ts": 1000.0, "progress": 3,
+        "phase": "prove",
+    }
+    return {
+        "kind": rl.BLACKBOX_KIND, "schema": 1, "record": "dump",
+        "seq": 2, "t_s": 2.0, "unix_ts": 1001.0, "progress": 3,
+        "phase": "prove", "reason": "stall", "stall_s": 5.0,
+        "span": span_path, "span_id": span_id,
+        "stacks": [{"thread": "MainThread", "stack": ["prove()"]}],
+        "faulthandler": "...", "heartbeats": [hb], "spans": spans,
+    }
+
+
+def test_validate_blackbox_rejects_span_id_path_disagreement():
+    tree = [_span("prove", 0.0, 1.0, "22" * 8, trace=TID)]
+    tree[0]["children"].append(
+        _span("msm", 0.1, 0.5, "33" * 8, parent="22" * 8)
+    )
+    ok = _dump("prove/msm", "33" * 8, tree)
+    assert rl.validate_blackbox(ok) == []
+    wrong_path = _dump("prove", "33" * 8, tree)
+    assert any(
+        "disagrees" in p for p in rl.validate_blackbox(wrong_path)
+    )
+    missing = _dump("prove/msm", "99" * 8, tree)
+    assert any(
+        "not present" in p for p in rl.validate_blackbox(missing)
+    )
+
+
+def _write_jsonl(path, docs):
+    with open(path, "w") as f:
+        for d in docs:
+            f.write(json.dumps(d) + "\n")
+
+
+def _run_cli(*argv):
+    cli = os.path.join(REPO_ROOT, "scripts", "prove_report.py")
+    env = {k: v for k, v in os.environ.items() if k != "PYTHONSTARTUP"}
+    return subprocess.run(
+        [sys.executable, cli, *argv],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+
+
+def test_cli_timeline_merges_two_hosts_and_exports_perfetto(tmp_path):
+    (l0, d0), (l1, d1) = _two_host_docs()
+    p0 = tmp_path / "host0.jsonl"
+    p1 = tmp_path / "host1.jsonl"
+    _write_jsonl(p0, d0)
+    _write_jsonl(p1, d1)
+    out = tmp_path / "trace.json"
+    res = _run_cli("--timeline", str(p0), str(p1), "--perfetto", str(out))
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "straggler" in res.stdout
+    with open(out) as f:
+        doc = json.load(f)
+    assert rl.validate_perfetto(doc) == []
+    names = {e["name"] for e in doc["traceEvents"]}
+    assert {"queue.wait", "prove", "clock_sync.barrier"} <= names
+
+
+def test_cli_check_fails_cross_line_span_id_collision(tmp_path):
+    a = _line("a", 1.0, 1.0, [_span("s", 0.0, 1.0, "11" * 8, trace=TID)])
+    b = _line("b", 2.0, 1.0, [_span("s", 0.0, 1.0, "11" * 8, trace=TID)])
+    p = tmp_path / "collide.jsonl"
+    _write_jsonl(p, [a, b])
+    res = _run_cli("--check", str(p))
+    assert res.returncode == 1
+    assert "collides" in res.stdout
+    # same two lines with distinct ids pass
+    b["spans"][0]["span_id"] = "22" * 8
+    _write_jsonl(p, [a, b])
+    res = _run_cli("--check", str(p))
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_timeline_empty_artifact_exits_nonzero(tmp_path):
+    p = tmp_path / "empty.jsonl"
+    _write_jsonl(p, [])
+    res = _run_cli("--timeline", str(p))
+    assert res.returncode == 1
+    assert "no events" in res.stdout
