@@ -123,6 +123,7 @@ def main():
 
     sweep_section(backend)
     resident_section(backend)
+    field_section(backend)
     mesh_section(backend)
 
 
@@ -343,6 +344,142 @@ def resident_section(backend):
         lambda a, b: _fri_fold_fn(3, True, None)(a, b, ch01, tabs_u),
         lambda a, b: _fri_fold_fn_p(3, None)(a, b, tb, tabs_p),
         (c0, c1), (c0p, c1p), m,
+    )
+
+
+def field_section(backend):
+    """ISSUE 19 satellite: per-kernel Goldilocks-limb vs BabyBear
+    plane-free microbench — iNTT, LDE, leaf sponge, gate-terms sweep,
+    FRI fold chain. The Goldilocks leg is the limb-RESIDENT twin (the
+    best Goldilocks path: (lo, hi) u32 planes, 8 bytes/elem); the
+    BabyBear leg is the plane-free `_bb` kernel (ONE u32 lane,
+    4 bytes/elem). Each line carries both backends' throughput plus the
+    bytes-per-element of each, so `prove_report.py --trend` tracks the
+    two field backends as separate series and the HBM-halving claim
+    stays a measured number, not an assertion."""
+    from boojum_tpu.field import babybear as bb
+    from boojum_tpu.field import limbs
+    from boojum_tpu.field.spec import BABYBEAR
+    from boojum_tpu.hashes.poseidon2 import leaf_hash_planes
+    from boojum_tpu.ntt import bb_ntt
+    from boojum_tpu.ntt import limb_ntt as LN
+    from boojum_tpu.prover import bb_kernels as K
+    from boojum_tpu.prover import pallas_sweep as ps
+    from boojum_tpu.prover import resident as RES
+    from boojum_tpu.prover.fri import (
+        _ch_table_np,
+        _fri_fold_fn_p,
+        fold_challenge_tables_p,
+    )
+
+    on_tpu = backend == "tpu"
+    log_n = 18 if on_tpu else 10
+    Lf = 4 if on_tpu else 2
+    n = 1 << log_n
+    N = n * Lf
+    reps = 4 if on_tpu else 2
+    rng = np.random.default_rng(33)
+
+    def rnd_gl(*s):
+        return jnp.asarray(rng.integers(0, gl.P, s, dtype=np.uint64))
+
+    def rnd_bb(*s):
+        return jnp.asarray(rng.integers(0, bb.P, s, dtype=np.uint32))
+
+    def compare(name, gl_fn, gl_args, bb_fn, bb_args, gl_elems, bb_elems):
+        dt_gl = timed_call(gl_fn, gl_args, reps)
+        dt_bb = timed_call(bb_fn, bb_args, reps)
+        gl_tp, bb_tp = gl_elems / dt_gl, bb_elems / dt_bb
+        emit(
+            f"field_{name}_bb_elems_per_s",
+            int(bb_tp),
+            "elems/s",
+            gl_limb_elems_per_s=int(gl_tp),
+            bb_over_gl=round(bb_tp / gl_tp, 3),
+            bytes_per_elem_bb=4,
+            bytes_per_elem_gl=8,
+            backend=backend,
+            interpret=not on_tpu,
+        )
+
+    # iNTT (values -> monomial) + LDE: limb planes vs one u32 lane
+    B = 16
+    xp = limbs.split(rnd_gl(B, n))
+    xb = rnd_bb(B, n)
+    compare(
+        "imono",
+        LN.monomial_from_values_p, (xp,),
+        lambda v: bb_ntt.monomial_from_values_bb(v, log_n), (xb,),
+        B * n, B * n,
+    )
+    shift = BABYBEAR.multiplicative_generator
+    compare(
+        "lde",
+        lambda m: LN.lde_from_monomial_p(m, Lf), (xp,),
+        lambda m: bb_ntt.lde_from_monomial_bb(m, log_n, Lf, shift), (xb,),
+        B * n * Lf, B * n * Lf,
+    )
+
+    # leaf sponge: width-12 Goldilocks permutation over (lo, hi) planes
+    # vs width-16 BabyBear permutation over bare lanes
+    T = 1 << (14 if on_tpu else 11)
+    leaves_p = limbs.split(rnd_gl(T, 16))
+    cols_b = rnd_bb(16, T)
+    compare(
+        "leaf_sponge",
+        leaf_hash_planes, (leaves_p,),
+        K.leaf_digests_bb, (cols_b,),
+        T * 16, T * 16,
+    )
+
+    # fused quotient sweep: the plane-resident gate-terms kernel vs the
+    # BabyBear coset sweep (random division tables — kernel throughput
+    # does not depend on table values)
+    from boojum_tpu.cs.gates import FmaGate
+    from boojum_tpu.cs.types import CSGeometry
+
+    geom = CSGeometry(8, 0, 6, 4)
+    gate = ps.gate_terms_fn((FmaGate.instance(),), ((),), geom)
+    n_terms = FmaGate.instance().num_repetitions(geom)
+    copy_p = limbs.split(rnd_gl(8, n))
+    const_p = limbs.split(rnd_gl(6, n))
+    a0 = [int(v) for v in np.asarray(rnd_gl(n_terms))]
+    a1 = [int(v) for v in np.asarray(rnd_gl(n_terms))]
+    table = jnp.asarray(RES.sc_table_np(a0, a1))
+    compare(
+        "gate_terms",
+        lambda c, k: gate.planes(c, None, k, table), (copy_p, const_p),
+        lambda w, al, cp, lt, zh, bi: K.coset_sweep_terms_bb(
+            w, al, cp, lt, zh, bi, Lf
+        ),
+        (rnd_bb(N), rnd_bb(4), rnd_bb(2), rnd_bb(N), rnd_bb(N), rnd_bb(N)),
+        8 * n, N,
+    )
+
+    # FRI fold chain: one k=3 plane-resident fold (GF(p^2): 2 u64/elem)
+    # vs the three chained factor-2 `_bb` folds a BabyBear prove
+    # actually dispatches (GF(p^4): 4 u32/elem)
+    m = N
+    log_m = m.bit_length() - 1
+    c0p, c1p = limbs.split(rnd_gl(m)), limbs.split(rnd_gl(m))
+    tb = jnp.asarray(_ch_table_np((3, 5)))
+    tabs_p = tuple(fold_challenge_tables_p(log_m, 3))
+    gl_fold = _fri_fold_fn_p(3, None)
+
+    cw = rnd_bb(4, m)
+    betas = [rnd_bb(4) for _ in range(3)]
+    invtabs = [rnd_bb(m >> (r + 1)) for r in range(3)]
+
+    def bb_fold_chain(c, b0, b1, b2, t0, t1, t2):
+        c = K.fri_fold_bb(c, b0, t0)
+        c = K.fri_fold_bb(c, b1, t1)
+        return K.fri_fold_bb(c, b2, t2)
+
+    compare(
+        "fri_fold_chain",
+        lambda a, b: gl_fold(a, b, tb, tabs_p), (c0p, c1p),
+        bb_fold_chain, (cw, *betas, *invtabs),
+        2 * m, 4 * m,
     )
 
 

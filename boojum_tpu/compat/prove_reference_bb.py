@@ -1,0 +1,139 @@
+"""Pure-NumPy reference leg of the BabyBear prover (ISSUE 19).
+
+`compat.prove_reference` closes the transcript-DIALECT loop against the
+Rust reference; this module closes the KERNEL loop for the new field
+backend: `NumpyBackendBB` re-implements every device kernel the BabyBear
+prover dispatches (iNTT, coset LDE, fused quotient sweep, DEEP
+accumulation, FRI fold, Merkle commit) as plain vectorized numpy, then
+runs the SAME `prover.bb_prover.prove_babybear` flow — same transcript,
+same challenge schedule, same checkpoint stream.
+
+Because the prover core is shared, `prove_babybear(pub, cfg,
+NumpyBackendBB())` must produce a bit-identical proof and an identical
+Fiat–Shamir checkpoint digest sequence to the device backend; any
+divergence localizes to exactly one kernel twin. This is the BabyBear
+counterpart of the golden-parity harness the Goldilocks leg already has.
+
+No device dispatch anywhere on this path: jax is still *imported*
+transitively (the shared host-table module decorates its kernels), but
+every array op the reference leg executes is numpy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..field import babybear as bb
+from ..hashes import poseidon2_bb as p2bb
+from ..ntt import bb_ntt
+from ..prover import bb_kernels as K
+from ..prover.bb_prover import BBProof, BBProofConfig, prove_babybear
+
+
+def _ext_cols(v) -> tuple:
+    """(4,) u32 challenge vector -> ext 4-tuple of numpy scalars."""
+    a = np.asarray(v, dtype=np.uint32)
+    return tuple(a[k] for k in range(4))
+
+
+def _base_minus_ext_np(base_arr, e):
+    shape = base_arr.shape
+    p = np.uint32(bb.P)
+    return (
+        bb.sub_np(base_arr, np.broadcast_to(e[0], shape)),
+        np.broadcast_to((p - e[1]) % p, shape),
+        np.broadcast_to((p - e[2]) % p, shape),
+        np.broadcast_to((p - e[3]) % p, shape),
+    )
+
+
+class NumpyBackendBB:
+    """The numpy twin of DeviceBackendBB: same np-in/np-out method seam,
+    kernels replaced by their host reference implementations."""
+
+    def intt(self, values):
+        return bb_ntt.ntt_np(np.asarray(values, dtype=np.uint32),
+                             inverse=True)
+
+    def lde(self, mono, log_n, lde_factor, shift):
+        return bb_ntt.lde_np(np.asarray(mono, dtype=np.uint32),
+                             lde_factor, shift)
+
+    def coset_sweep(self, w_lde, alpha, cfg: BBProofConfig, pub: int):
+        args = (cfg.log_n, cfg.lde_factor, cfg.shift)
+        w_lde = np.asarray(w_lde, dtype=np.uint32)
+        wg = np.roll(w_lde, -cfg.lde_factor)
+        trans = bb.sub_np(
+            wg,
+            bb.add_np(bb.mul_np(w_lde, w_lde),
+                      np.uint32(cfg.square_c % bb.P)),
+        )
+        qt = bb.mul_np(bb.mul_np(trans, K.last_row_term_bb(*args)),
+                       K.zh_inv_bb(*args))
+        qb = bb.mul_np(bb.sub_np(w_lde, np.uint32(pub % bb.P)),
+                       K.boundary_inv_bb(*args))
+        a = [np.uint32(c) for c in alpha]
+        out = [bb.add_np(qt, bb.mul_np(qb, a[0]))]
+        out += [bb.mul_np(qb, a[k]) for k in range(1, 4)]
+        return np.stack(out)
+
+    def deep(self, w_lde, q_cols, xs, z, gz, wz, wgz, qz, gammas):
+        w_lde = np.asarray(w_lde, dtype=np.uint32)
+        q_cols = np.asarray(q_cols, dtype=np.uint32)
+        xs = np.asarray(xs, dtype=np.uint32)
+        g = [_ext_cols(gm) for gm in gammas]
+        num = bb.ext_mul_np(
+            g[0], _base_minus_ext_np(w_lde, _ext_cols(wz))
+        )
+        for k in range(4):
+            num = bb.ext_add_np(
+                num,
+                bb.ext_mul_np(
+                    g[2 + k],
+                    _base_minus_ext_np(q_cols[k], _ext_cols(qz[k])),
+                ),
+            )
+        d1 = bb.ext_mul_np(
+            num, bb.ext_inv_np(_base_minus_ext_np(xs, _ext_cols(z)))
+        )
+        d2 = bb.ext_mul_np(
+            bb.ext_mul_np(
+                g[1], _base_minus_ext_np(w_lde, _ext_cols(wgz))
+            ),
+            bb.ext_inv_np(_base_minus_ext_np(xs, _ext_cols(gz))),
+        )
+        return np.stack(bb.ext_add_np(d1, d2))
+
+    def fold(self, codeword, beta, inv2x):
+        codeword = np.asarray(codeword, dtype=np.uint32)
+        inv2x = np.asarray(inv2x, dtype=np.uint32)
+        half = codeword.shape[-1] // 2
+        a = tuple(codeword[k, :half] for k in range(4))
+        b = tuple(codeword[k, half:] for k in range(4))
+        inv2 = np.uint32(K.INV2)
+        even = tuple(
+            bb.mul_np(bb.add_np(x, y), inv2) for x, y in zip(a, b)
+        )
+        odd = tuple(
+            bb.mul_np(bb.sub_np(x, y), inv2x) for x, y in zip(a, b)
+        )
+        out = bb.ext_add_np(
+            even, bb.ext_mul_np(_ext_cols(beta), odd)
+        )
+        return np.stack(out)
+
+    def commit(self, cols, cap_size: int) -> K.BBMerkleTree:
+        cols = np.asarray(cols, dtype=np.uint32)
+        digests = p2bb.leaf_hash_bb_np(cols.T)
+        layers = [digests]
+        while layers[-1].shape[0] > cap_size:
+            cur = layers[-1]
+            layers.append(p2bb.node_hash_bb_np(cur[0::2], cur[1::2]))
+        return K.BBMerkleTree(layers, cap_size)
+
+
+def prove_babybear_reference(
+    pub: int, cfg: BBProofConfig | None = None
+) -> BBProof:
+    """Run the shared BabyBear prover over the numpy kernel twins."""
+    return prove_babybear(pub, cfg, backend=NumpyBackendBB())

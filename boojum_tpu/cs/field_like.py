@@ -14,11 +14,19 @@ same contract is a tiny duck-typed ops object:
                  64-bit integer datapath)
 - ExtScalarOps : (int, int) tuples, GF(p^2)     (plain verifier at z)
 - circuit ops  : gadget Nums (recursive verifier, later layer)
+
+BabyBear twins (ISSUE 19) speak the same contract over one u32 lane per
+element — no LimbOps analogue exists because BabyBear never splits:
+
+- BBScalarOps    : python ints mod 2^31-2^27+1
+- BBArrayOps     : jnp uint32 arrays, plane-free
+- BBExtScalarOps : 4-tuples, GF(p^4) = GF(p)[w]/(w^4 - 11)
 """
 
 import jax.numpy as jnp
 
 from ..field import gl
+from ..field import babybear as bb
 from ..field import extension as ext_f
 from ..field import goldilocks as gf
 from ..field import limbs as _limbs
@@ -115,3 +123,79 @@ class ExtScalarOps:
     @staticmethod
     def double(a):
         return ext_f.add_s(a, a)
+
+
+class BBScalarOps:
+    """BabyBear base-field ops over python ints (satisfiability checks
+    of a circuit declared over the BabyBear backend)."""
+
+    @staticmethod
+    def zero():
+        return 0
+
+    @staticmethod
+    def one():
+        return 1
+
+    @staticmethod
+    def constant(v: int):
+        return v % bb.P
+
+    add = staticmethod(bb.add_s)
+    sub = staticmethod(bb.sub_s)
+    mul = staticmethod(bb.mul_s)
+    neg = staticmethod(bb.neg_s)
+
+    @staticmethod
+    def double(a):
+        return bb.add_s(a, a)
+
+
+class BBArrayOps:
+    """BabyBear base-field ops over whole jnp uint32 arrays — the
+    plane-free twin of ArrayOps: one lane per element, no limb pairs
+    anywhere, so the same gate evaluator vectorizes over the LDE domain
+    at half the HBM bytes of the Goldilocks path."""
+
+    @staticmethod
+    def zero():
+        return jnp.uint32(0)
+
+    @staticmethod
+    def one():
+        return jnp.uint32(1)
+
+    @staticmethod
+    def constant(v: int):
+        return jnp.uint32(v % bb.P)
+
+    add = staticmethod(bb.add)
+    sub = staticmethod(bb.sub)
+    mul = staticmethod(bb.mul)
+    neg = staticmethod(bb.neg)
+    double = staticmethod(bb.double)
+
+
+class BBExtScalarOps:
+    """GF(p^4) ops over 4-tuples of python ints (BabyBear verifier at z)."""
+
+    @staticmethod
+    def zero():
+        return bb.ZERO_S
+
+    @staticmethod
+    def one():
+        return bb.ONE_S
+
+    @staticmethod
+    def constant(v: int):
+        return bb.ext_from_base_s(v % bb.P)
+
+    add = staticmethod(bb.ext_add_s)
+    sub = staticmethod(bb.ext_sub_s)
+    mul = staticmethod(bb.ext_mul_s)
+    neg = staticmethod(bb.ext_neg_s)
+
+    @staticmethod
+    def double(a):
+        return bb.ext_add_s(a, a)

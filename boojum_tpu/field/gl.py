@@ -7,11 +7,16 @@ counterpart of the reference's scalar `GoldilocksField` impl
 `goldilocks.py`.
 """
 
-P = 0xFFFFFFFF00000001
+# the protocol-defining constants live on the FieldSpec record
+# (field/spec.py, ISSUE 19) — re-exported here so every historical
+# `gl.P` call site keeps reading the same values from one source
+from .spec import GOLDILOCKS as _SPEC
+
+P = _SPEC.p
 EPSILON = 0xFFFFFFFF
-MULTIPLICATIVE_GENERATOR = 7
-RADIX_2_SUBGROUP_GENERATOR = 0x185629DCDA58878C
-TWO_ADICITY = 32
+MULTIPLICATIVE_GENERATOR = _SPEC.multiplicative_generator
+RADIX_2_SUBGROUP_GENERATOR = _SPEC.radix2_subgroup_generator
+TWO_ADICITY = _SPEC.two_adicity
 
 
 def add(a: int, b: int) -> int:

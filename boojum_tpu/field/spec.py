@@ -1,0 +1,127 @@
+"""FieldSpec: the one place a field's protocol constants live (ISSUE 19).
+
+Every Goldilocks-specific literal that used to be sprinkled through the
+transcript (8-byte absorb words, 64-bit challenge widths), FRI ((p+1)/2),
+Merkle packing (4-element digests) and the cost model (8 bytes/element)
+reads from here now — and the BabyBear backend is just a second instance
+of the same record, selected by ``BOOJUM_TPU_FIELD={goldilocks,babybear}``
+with Goldilocks the untouched default.
+
+Why BabyBear: p = 2^31 - 2^27 + 1 fits ONE u32 lane per field element.
+Goldilocks on TPU stores every element as a (lo, hi) u32 plane pair and
+pays four cross-products plus a carry chain per multiply; BabyBear halves
+the HBM/ICI/DCN bytes per element and multiplies in a single widened
+product. Its two-adicity (27) clears every domain this repo builds
+(2^10 traces, LDE factor <= 8), so the radix-2 NTT machinery applies
+unchanged. The price is challenge soundness: 31-bit challenges are far
+too small, so challenges/DEEP/FRI run over the degree-4 extension
+GF(p^4) = GF(p)[x]/(x^4 - 11) (~124-bit ext order), where Goldilocks
+needs only degree 2.
+
+Stdlib-only at import time: transcripts, scripts and the report CLI read
+these records without dragging in jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+
+@dataclasses.dataclass(frozen=True)
+class FieldSpec:
+    name: str
+    p: int
+    two_adicity: int
+    multiplicative_generator: int
+    radix2_subgroup_generator: int  # primitive 2^two_adicity-th root of 1
+    ext_degree: int  # extension degree challenges are drawn over
+    ext_nonresidue: int  # GF(p^d) = GF(p)[x]/(x^d - ext_nonresidue)
+    elem_bytes: int  # canonical on-device bytes per base element
+    challenge_bits: int  # bits of one transcript challenge word
+    digest_elems: int  # base elements per Merkle digest
+    sponge_width: int  # Poseidon2 state width
+    sponge_rate: int
+
+    @property
+    def half(self) -> int:
+        """(p+1)/2 — the multiplicative inverse of 2 mod p."""
+        return (self.p + 1) // 2
+
+    @property
+    def challenge_bytes(self) -> int:
+        """LE word width a byte-oriented transcript absorbs one element as."""
+        return (self.challenge_bits + 7) // 8
+
+    @property
+    def sponge_capacity(self) -> int:
+        return self.sponge_width - self.sponge_rate
+
+    def omega(self, log_n: int) -> int:
+        """Primitive 2^log_n-th root of unity (two-adic tower)."""
+        assert log_n <= self.two_adicity
+        w = self.radix2_subgroup_generator
+        for _ in range(self.two_adicity - log_n):
+            w = (w * w) % self.p
+        return w
+
+
+GOLDILOCKS = FieldSpec(
+    name="goldilocks",
+    p=0xFFFFFFFF00000001,
+    two_adicity=32,
+    multiplicative_generator=7,
+    radix2_subgroup_generator=0x185629DCDA58878C,
+    ext_degree=2,
+    ext_nonresidue=7,
+    elem_bytes=8,  # one u64 (= two u32 limb planes on device)
+    challenge_bits=64,
+    digest_elems=4,
+    sponge_width=12,
+    sponge_rate=8,
+)
+
+_BB_P = (1 << 31) - (1 << 27) + 1  # 2013265921
+
+BABYBEAR = FieldSpec(
+    name="babybear",
+    p=_BB_P,
+    two_adicity=27,
+    multiplicative_generator=31,
+    # 31^((p-1)/2^27) mod p — the canonical two-adic generator
+    radix2_subgroup_generator=pow(31, (_BB_P - 1) >> 27, _BB_P),
+    ext_degree=4,  # 31-bit challenges are unsound; GF(p^4) ~ 2^124
+    ext_nonresidue=11,  # x^4 - 11 is irreducible over GF(p)
+    elem_bytes=4,  # ONE u32 lane — the whole point
+    challenge_bits=31,
+    digest_elems=8,
+    sponge_width=16,
+    sponge_rate=8,
+)
+
+SPECS = {s.name: s for s in (GOLDILOCKS, BABYBEAR)}
+
+_ENV = "BOOJUM_TPU_FIELD"
+
+
+def active_field() -> str:
+    """The selected field backend name. Read from ``BOOJUM_TPU_FIELD`` at
+    CALL time (not import time) so tests can flip it per-case; unset or
+    empty means Goldilocks — the untouched default path."""
+    v = os.environ.get(_ENV, "").strip().lower()
+    if not v:
+        return "goldilocks"
+    if v not in SPECS:
+        raise ValueError(
+            f"{_ENV}={v!r}: unknown field backend (want one of "
+            f"{sorted(SPECS)})"
+        )
+    return v
+
+
+def active_spec() -> FieldSpec:
+    return SPECS[active_field()]
+
+
+def is_babybear() -> bool:
+    return active_field() == "babybear"

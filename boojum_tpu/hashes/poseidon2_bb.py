@@ -1,0 +1,430 @@
+"""Poseidon2 BabyBear (t=16, x^7) — batched device + Pallas twin + host scalar.
+
+The BOOJUM_TPU_FIELD=babybear sponge (ISSUE 19). Same shape as the
+Goldilocks module (`poseidon2.py`): pre-multiply by the external matrix
+circ(2*M4, M4, M4, M4), 4 full rounds, 13 partial rounds with the internal
+all-ones+diag matrix, 4 full rounds — but over bare u32 lanes, so there are
+no (lo, hi) planes anywhere: one leaf row is HALF the bytes of its
+Goldilocks twin.
+
+Three implementations of the one round function:
+  - XLA (`poseidon2_permutation_bb_xla`): canonical-domain u32 ops, muls
+    widen to u64 inside the graph (field/babybear.py ops);
+  - Pallas (`_permutation_bb_block`): u32-ONLY Montgomery arithmetic —
+    Mosaic has no 64-bit datapath, so in-kernel muls are 16-bit-split
+    32x32->64 products + REDC folds (the BabyBear counterpart of the
+    Goldilocks limb kernels, one u32 lane instead of two);
+  - host (`poseidon2_permutation_bb_host`): python ints for the
+    transcript/verifier.
+
+Digests are 8 lanes (8 x 31 bits); leaves absorb rate-8 overwrite-mode
+chunks, nodes compress by truncated permutation (left ‖ right fills the
+full width, one permutation, take the first 8) — the standard 2-to-1
+compression at digest = rate.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import babybear as bb
+from ..field.limbs import mul32_wide
+from . import poseidon2_params as params
+
+P = params.BB_P
+WIDTH = params.BB_STATE_WIDTH
+RATE = params.BB_RATE
+DIGEST = 8
+
+_RC_EXT = np.array(params.BB_EXTERNAL_ROUND_CONSTANTS, dtype=np.uint32)
+_RC_INT = np.array(params.BB_INTERNAL_ROUND_CONSTANTS, dtype=np.uint32)
+_DIAG = np.array(params.BB_M_I_DIAGONAL, dtype=np.uint32)
+
+# Montgomery constants for the Pallas kernel (R = 2^32)
+_MONT_R2 = np.uint32((1 << 64) % P)
+_MONT_MU = np.uint32((-pow(P, -1, 1 << 32)) % (1 << 32))
+_MONT_ONE = np.uint32((1 << 32) % P)
+
+
+def _to_mont_np(x):
+    return ((x.astype(np.uint64) << np.uint64(32)) % np.uint64(P)).astype(
+        np.uint32
+    )
+
+
+_RC_EXT_MONT = _to_mont_np(_RC_EXT)
+_RC_INT_MONT = _to_mont_np(_RC_INT)
+_DIAG_MONT = _to_mont_np(_DIAG)
+
+
+def _sbox7(x, mul):
+    x2 = mul(x, x)
+    x3 = mul(x2, x)
+    x4 = mul(x2, x2)
+    return mul(x4, x3)
+
+
+def _block_m4(x0, x1, x2, x3, add, double):
+    """M4 = [[5,7,1,3],[4,6,1,1],[1,3,5,7],[1,1,4,6]] via add/double chain
+    (same chain as the Goldilocks module)."""
+    t0 = add(x0, x1)
+    t1 = add(x2, x3)
+    t2 = add(double(x1), t1)
+    t3 = add(double(x3), t0)
+    t4 = add(double(double(t1)), t3)
+    t5 = add(double(double(t0)), t2)
+    t6 = add(t3, t5)
+    t7 = add(t2, t4)
+    return t6, t5, t7, t4
+
+
+def _external_cols(cols, add, double):
+    """circ(2*M4, M4, M4, M4) over 16 per-lane columns."""
+    blocks = [
+        _block_m4(*cols[4 * b : 4 * b + 4], add, double) for b in range(4)
+    ]
+    sums = []
+    for i in range(4):
+        s = add(add(blocks[0][i], blocks[1][i]), add(blocks[2][i], blocks[3][i]))
+        sums.append(s)
+    out = []
+    for b in range(4):
+        for i in range(4):
+            out.append(add(blocks[b][i], sums[i]))
+    return out
+
+
+def _internal_cols(cols, add, mul, diag):
+    """M_I = all-ones + diag(d): out_i = d_i*x_i + sum_j x_j."""
+    total = cols[0]
+    for c in cols[1:]:
+        total = add(total, c)
+    return [add(mul(c, d), total) for c, d in zip(cols, diag)]
+
+
+# ---------------------------------------------------------------------------
+# XLA path (canonical domain)
+# ---------------------------------------------------------------------------
+
+
+def _external_mds_bb(state):
+    """state (..., 16) -> circ(2*M4, M4, M4, M4) · state."""
+    cols = [state[..., i] for i in range(WIDTH)]
+    return jnp.stack(_external_cols(cols, bb.add, bb.double), axis=-1)
+
+
+@jax.jit
+def poseidon2_permutation_bb_xla(state: jax.Array) -> jax.Array:
+    """Batched permutation on (..., 16) uint32 arrays. Rounds run under
+    `lax.fori_loop` for the same reason the Goldilocks module loops: one
+    round body per phase keeps XLA compile time flat (an unrolled 21-round
+    graph measured 2min+ of CPU compile)."""
+    rc_ext = jnp.asarray(_RC_EXT)
+    rc_int = jnp.asarray(_RC_INT)
+    diag = jnp.asarray(_DIAG)
+
+    def full_round(r, s):
+        s = bb.add(s, rc_ext[r])
+        s = _sbox7(s, bb.mul)
+        return _external_mds_bb(s)
+
+    def partial_round(r, s):
+        el0 = _sbox7(bb.add(s[..., 0], rc_int[r]), bb.mul)
+        s = jnp.concatenate([el0[..., None], s[..., 1:]], axis=-1)
+        # lane sum: widen once — 16 summands of < 2^31 fit u64 exactly
+        total = (jnp.sum(s.astype(jnp.uint64), axis=-1) % jnp.uint64(P)).astype(
+            jnp.uint32
+        )
+        return bb.add(bb.mul(s, diag), total[..., None])
+
+    state = _external_mds_bb(state)
+    state = jax.lax.fori_loop(0, 4, full_round, state)
+    state = jax.lax.fori_loop(
+        0, params.BB_NUM_PARTIAL_ROUNDS, partial_round, state
+    )
+    state = jax.lax.fori_loop(4, 8, full_round, state)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# Pallas path: u32-only Montgomery round function
+# ---------------------------------------------------------------------------
+
+
+def _mont_mul(a, b):
+    """a*b*R^-1 mod p with u32 ops only (REDC). a, b < p."""
+    t_lo, t_hi = mul32_wide(a, b)
+    m = t_lo * jnp.uint32(_MONT_MU)  # wrapping low product
+    _mp_lo, mp_hi = mul32_wide(m, jnp.full_like(m, np.uint32(P)))
+    # t_lo + mp_lo == 0 mod 2^32 by construction: carry = (t_lo != 0)
+    carry = (t_lo != 0).astype(jnp.uint32)
+    u = t_hi + mp_hi + carry  # < 2p
+    return jnp.where(u >= jnp.uint32(P), u - jnp.uint32(P), u)
+
+
+def _mont_add(a, b):
+    s = a + b
+    return jnp.where(s >= jnp.uint32(P), s - jnp.uint32(P), s)
+
+
+def _mont_double(a):
+    return _mont_add(a, a)
+
+
+def _permutation_bb_stack(s, rc_ext, rc_int, diag):
+    """The full 21-round permutation on a (16, T) Montgomery-domain u32
+    stack — the Pallas kernel core (also runs as plain jnp in interpret
+    mode on CPU). Constant tables arrive as kernel inputs (Pallas rejects
+    captured device constants): rc_ext (8, 16), rc_int (13, 1),
+    diag (16, 1), all Montgomery-form. Rounds loop under fori_loop, same
+    compile-time posture as the XLA path."""
+
+    def ext_mds(s):
+        cols = [s[i] for i in range(WIDTH)]
+        return jnp.stack(_external_cols(cols, _mont_add, _mont_double))
+
+    def full_round(r, s):
+        s = _mont_add(s, rc_ext[r][:, None])
+        s = _sbox7(s, _mont_mul)
+        return ext_mds(s)
+
+    def partial_round(r, s):
+        c0 = _sbox7(_mont_add(s[0], rc_int[r, 0]), _mont_mul)
+        s = jnp.concatenate([c0[None], s[1:]], axis=0)
+        # lane sum as a 4-level _mont_add tree (no u64 in a Pallas body)
+        t = _mont_add(s[:8], s[8:])
+        t = _mont_add(t[:4], t[4:])
+        t = _mont_add(t[:2], t[2:])
+        total = _mont_add(t[0], t[1])
+        return _mont_add(_mont_mul(s, diag), total[None])
+
+    s = ext_mds(s)
+    s = jax.lax.fori_loop(0, 4, full_round, s)
+    s = jax.lax.fori_loop(0, params.BB_NUM_PARTIAL_ROUNDS, partial_round, s)
+    s = jax.lax.fori_loop(4, 8, full_round, s)
+    return s
+
+
+def _perm_kernel(x_ref, rce_ref, rci_ref, diag_ref, o_ref):
+    x = x_ref[...]  # (16, T) canonical u32
+    r2 = jnp.full_like(x, _MONT_R2)
+    s = _mont_mul(x, r2)  # to Montgomery
+    s = _permutation_bb_stack(s, rce_ref[...], rci_ref[...], diag_ref[...])
+    o_ref[...] = _mont_mul(s, jnp.ones_like(s))  # from Montgomery
+
+
+def poseidon2_permutation_bb_pallas(state, interpret=None):
+    """(N, 16) canonical u32 -> (N, 16), tiled (16, T) blocks through one
+    pallas_call. Interpret mode off-TPU (the CPU correctness twin)."""
+    from jax.experimental import pallas as pl
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    n = state.shape[0]
+    T = min(512, max(8, n))
+    pad = (-n) % T
+    x = jnp.pad(state, ((0, pad), (0, 0))).T  # (16, n+pad)
+    total = n + pad
+    out = pl.pallas_call(
+        _perm_kernel,
+        grid=(total // T,),
+        in_specs=[
+            pl.BlockSpec((WIDTH, T), lambda i: (0, i)),
+            pl.BlockSpec((8, WIDTH), lambda i: (0, 0)),
+            pl.BlockSpec((params.BB_NUM_PARTIAL_ROUNDS, 1), lambda i: (0, 0)),
+            pl.BlockSpec((WIDTH, 1), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((WIDTH, T), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((WIDTH, total), jnp.uint32),
+        interpret=interpret,
+    )(
+        x,
+        jnp.asarray(_RC_EXT_MONT),
+        jnp.asarray(_RC_INT_MONT)[:, None],
+        jnp.asarray(_DIAG_MONT)[:, None],
+    )
+    return out.T[:n]
+
+
+def _pallas_ready(n: int) -> bool:
+    from ..utils.pallas_util import pallas_enabled
+
+    if not pallas_enabled():
+        return False
+    return n >= 8
+
+
+def poseidon2_permutation_bb(state: jax.Array) -> jax.Array:
+    """Dispatch: Pallas on TPU for 2-D batches, XLA otherwise."""
+    if state.ndim == 2 and _pallas_ready(state.shape[0]):
+        return poseidon2_permutation_bb_pallas(state)
+    return poseidon2_permutation_bb_xla(state)
+
+
+# ---------------------------------------------------------------------------
+# Sponge / Merkle hashing (rate 8, digest 8, overwrite mode)
+# ---------------------------------------------------------------------------
+
+
+def _sponge_hash_bb(values: jax.Array, permutation) -> jax.Array:
+    """Overwrite-mode sponge over (..., L) -> (..., 8): each full rate-8
+    chunk overwrites the rate lanes then permutes; a trailing partial
+    chunk is zero-padded (same finalize semantics as the Goldilocks
+    sponge)."""
+    lead = values.shape[:-1]
+    L = values.shape[-1]
+    state = jnp.zeros(lead + (WIDTH,), jnp.uint32)
+    full = L // RATE
+
+    def _absorb(c, st):
+        chunk = jax.lax.dynamic_slice_in_dim(values, RATE * c, RATE, axis=-1)
+        st = jnp.concatenate([chunk, st[..., RATE:]], axis=-1)
+        return permutation(st)
+
+    if full > 0:
+        state = jax.lax.fori_loop(0, full, _absorb, state)
+    rem = L - RATE * full
+    if rem > 0:
+        chunk = values[..., RATE * full :]
+        pad = jnp.zeros(lead + (RATE - rem,), jnp.uint32)
+        state = jnp.concatenate([chunk, pad, state[..., RATE:]], axis=-1)
+        state = permutation(state)
+    return state[..., :DIGEST]
+
+
+@jax.jit
+def leaf_hash_bb_xla(values: jax.Array) -> jax.Array:
+    """Hash (..., L) BabyBear values into (..., 8) leaf digests."""
+    return _sponge_hash_bb(values, poseidon2_permutation_bb_xla)
+
+
+def leaf_hash_bb(values: jax.Array) -> jax.Array:
+    if values.ndim == 2 and _pallas_ready(values.shape[0]):
+        return _sponge_hash_bb(values, poseidon2_permutation_bb_pallas)
+    return leaf_hash_bb_xla(values)
+
+
+@jax.jit
+def node_hash_bb_xla(left: jax.Array, right: jax.Array) -> jax.Array:
+    """Truncated-permutation 2-to-1 compression: (..., 8) x (..., 8) ->
+    (..., 8). left ‖ right fills the full width — one permutation."""
+    state = jnp.concatenate([left, right], axis=-1)
+    return poseidon2_permutation_bb_xla(state)[..., :DIGEST]
+
+
+def node_hash_bb(left: jax.Array, right: jax.Array) -> jax.Array:
+    if left.ndim == 2 and _pallas_ready(left.shape[0]):
+        state = jnp.concatenate([left, right], axis=-1)
+        return poseidon2_permutation_bb_pallas(state)[..., :DIGEST]
+    return node_hash_bb_xla(left, right)
+
+
+# ---------------------------------------------------------------------------
+# NumPy batch twin (compat/prove_reference_bb.py) — vectorized host, no jax
+# ---------------------------------------------------------------------------
+
+
+def poseidon2_permutation_bb_np(states: np.ndarray) -> np.ndarray:
+    """(T, 16) uint32 -> (T, 16), bit-identical to the device paths —
+    the reference prover's Merkle workhorse (the scalar host mirror below
+    is transcript-scale only)."""
+    states = np.asarray(states, dtype=np.uint32)
+
+    def ext_mds(cols):
+        return _external_cols(cols, bb.add_np, lambda x: bb.add_np(x, x))
+
+    cols = [states[:, i].copy() for i in range(WIDTH)]
+    cols = ext_mds(cols)
+    for r in range(4):
+        cols = [bb.add_np(c, np.uint32(rc)) for c, rc in zip(cols, _RC_EXT[r])]
+        cols = [_sbox7(c, bb.mul_np) for c in cols]
+        cols = ext_mds(cols)
+    diag = [np.uint32(d) for d in _DIAG]
+    for r in range(params.BB_NUM_PARTIAL_ROUNDS):
+        cols[0] = _sbox7(bb.add_np(cols[0], np.uint32(_RC_INT[r])), bb.mul_np)
+        total = (
+            np.sum(np.stack(cols).astype(np.uint64), axis=0) % np.uint64(P)
+        ).astype(np.uint32)
+        cols = [bb.add_np(bb.mul_np(c, d), total) for c, d in zip(cols, diag)]
+    for r in range(4, 8):
+        cols = [bb.add_np(c, np.uint32(rc)) for c, rc in zip(cols, _RC_EXT[r])]
+        cols = [_sbox7(c, bb.mul_np) for c in cols]
+        cols = ext_mds(cols)
+    return np.stack(cols, axis=-1)
+
+
+def leaf_hash_bb_np(values: np.ndarray) -> np.ndarray:
+    """(T, L) uint32 -> (T, 8) digests (overwrite-mode sponge, numpy)."""
+    values = np.asarray(values, dtype=np.uint32)
+    T, L = values.shape
+    state = np.zeros((T, WIDTH), dtype=np.uint32)
+    for c in range(0, L, RATE):
+        chunk = values[:, c : c + RATE]
+        if chunk.shape[1] < RATE:
+            chunk = np.pad(chunk, ((0, 0), (0, RATE - chunk.shape[1])))
+        state = np.concatenate([chunk, state[:, RATE:]], axis=1)
+        state = poseidon2_permutation_bb_np(state)
+    return state[:, :DIGEST]
+
+
+def node_hash_bb_np(left: np.ndarray, right: np.ndarray) -> np.ndarray:
+    state = np.concatenate([left, right], axis=-1).astype(np.uint32)
+    return poseidon2_permutation_bb_np(state)[:, :DIGEST]
+
+
+# ---------------------------------------------------------------------------
+# Host scalar mirror (transcript / verifier)
+# ---------------------------------------------------------------------------
+
+
+def poseidon2_permutation_bb_host(state):
+    """Python-int permutation, bit-identical to the device paths."""
+    assert len(state) == WIDTH
+    cols = [int(x) % P for x in state]
+
+    def add(a, b):
+        return bb.add_s(a, b)
+
+    def double(a):
+        return bb.add_s(a, a)
+
+    def mul(a, b):
+        return bb.mul_s(a, b)
+
+    def sbox(x):
+        return _sbox7(x, mul)
+
+    cols = _external_cols(cols, add, double)
+    for r in range(4):
+        cols = [add(c, int(rc)) for c, rc in zip(cols, _RC_EXT[r])]
+        cols = [sbox(c) for c in cols]
+        cols = _external_cols(cols, add, double)
+    for r in range(params.BB_NUM_PARTIAL_ROUNDS):
+        cols = [sbox(add(cols[0], int(_RC_INT[r])))] + cols[1:]
+        cols = _internal_cols(cols, add, mul, [int(d) for d in _DIAG])
+    for r in range(4, 8):
+        cols = [add(c, int(rc)) for c, rc in zip(cols, _RC_EXT[r])]
+        cols = [sbox(c) for c in cols]
+        cols = _external_cols(cols, add, double)
+    return cols
+
+
+def leaf_hash_bb_host(values) -> list:
+    """Host sponge over a python int sequence -> 8-element digest list."""
+    state = [0] * WIDTH
+    vals = [int(v) % P for v in values]
+    full = len(vals) // RATE
+    for c in range(full):
+        state[:RATE] = vals[RATE * c : RATE * (c + 1)]
+        state = poseidon2_permutation_bb_host(state)
+    rem = len(vals) - RATE * full
+    if rem > 0:
+        chunk = vals[RATE * full :] + [0] * (RATE - rem)
+        state[:RATE] = chunk
+        state = poseidon2_permutation_bb_host(state)
+    return state[:DIGEST]
+
+
+def node_hash_bb_host(left, right) -> list:
+    state = [int(x) % P for x in list(left) + list(right)]
+    return poseidon2_permutation_bb_host(state)[:DIGEST]

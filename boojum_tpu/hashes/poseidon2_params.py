@@ -117,3 +117,71 @@ ALL_ROUND_CONSTANTS = [
 ROUND_CONSTANTS_PER_ROUND = [
     ALL_ROUND_CONSTANTS[r * 12 : (r + 1) * 12] for r in range(TOTAL_NUM_ROUNDS)
 ]
+
+
+# ---------------------------------------------------------------------------
+# Poseidon2 BabyBear, width 16 (ISSUE 19 — the BOOJUM_TPU_FIELD=babybear
+# backend's sponge). p = 2^31 - 2^27 + 1; x^7 sbox (gcd(7, p-1) = 1);
+# external matrix circ(2*M4, M4, M4, M4); internal all-ones + diag; 4 + 13
+# + 4 rounds (width-16 BabyBear round counts per the Poseidon2 paper's
+# 128-bit instantiations). Unlike the Goldilocks table above there is no
+# upstream implementation these must be bit-compatible with — the BabyBear
+# leg defines its own protocol, verified by its own verifier — so the
+# constants are PROTOCOL-DEFINING here: derived once by deterministic
+# bias-free rejection sampling from blake2b(domain-tag ‖ counter), which
+# both the device kernels and the NumPy reference prover read from this
+# module. Changing them is a protocol break, same as editing the Goldilocks
+# table.
+# ---------------------------------------------------------------------------
+
+BB_P = (1 << 31) - (1 << 27) + 1  # 2013265921
+BB_STATE_WIDTH = 16
+BB_RATE = 8
+BB_CAPACITY = 8
+BB_HALF_NUM_FULL_ROUNDS = 4
+BB_NUM_FULL_ROUNDS_TOTAL = 8
+BB_NUM_PARTIAL_ROUNDS = 13
+BB_TOTAL_NUM_ROUNDS = 21
+
+
+def _bb_sample(tag: str, count: int) -> list:
+    """Deterministic bias-free field elements: 4-byte LE words from a
+    blake2b counter stream, rejecting w >= 2p (floor(2^32/p) = 2, so
+    accepting w < 2p and folding w mod p is exactly uniform)."""
+    import hashlib
+
+    out: list = []
+    ctr = 0
+    bound = 2 * BB_P
+    while len(out) < count:
+        h = hashlib.blake2b(
+            f"boojum_tpu.poseidon2.babybear.{tag}.{ctr}".encode(),
+            digest_size=32,
+        ).digest()
+        ctr += 1
+        for i in range(0, 32, 4):
+            w = int.from_bytes(h[i : i + 4], "little")
+            if w < bound:
+                out.append(w % BB_P)
+                if len(out) == count:
+                    break
+    return out
+
+
+# 8 full rounds x 16 lanes; partial rounds add a constant to lane 0 only.
+BB_EXTERNAL_ROUND_CONSTANTS = [
+    _bb_sample("external", BB_NUM_FULL_ROUNDS_TOTAL * BB_STATE_WIDTH)[
+        r * BB_STATE_WIDTH : (r + 1) * BB_STATE_WIDTH
+    ]
+    for r in range(BB_NUM_FULL_ROUNDS_TOTAL)
+]
+BB_INTERNAL_ROUND_CONSTANTS = _bb_sample("internal", BB_NUM_PARTIAL_ROUNDS)
+
+# Internal-matrix diagonal (M_I = all-ones + diag(d)); sampled from the
+# same stream, with d_i != 0 and d_i != p-1 enforced (either would zero a
+# diagonal term of M_I - J + I's spectrum trivially).
+BB_M_I_DIAGONAL = [
+    d for d in _bb_sample("diagonal", 4 * BB_STATE_WIDTH)
+    if d not in (0, BB_P - 1)
+][:BB_STATE_WIDTH]
+assert len(BB_M_I_DIAGONAL) == BB_STATE_WIDTH

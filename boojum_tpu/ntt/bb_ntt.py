@@ -1,0 +1,160 @@
+"""BabyBear NTT/LDE on bare u32 lanes (ISSUE 19).
+
+The plane-free twin of the Goldilocks transform stack: two-adicity 27
+clears every domain this repo builds, so the radix-2 structure carries
+over unchanged — only the butterflies shrink from (lo, hi) limb-pair
+carry chains to single u32 lanes (half the HBM traffic per stage).
+
+Layout contract (simpler than ntt.py's bit-reversed pipeline — the
+BabyBear prover is a self-contained leg, so it keeps everything in
+NATURAL order):
+  - `monomial_from_values_bb`: (..., n) natural-order evaluations over
+    the size-n subgroup -> natural-order monomial coefficients (iNTT);
+  - `values_from_monomial_bb`: the forward inverse of the above;
+  - `lde_from_monomial_bb`: monomials -> natural-order evaluations over
+    the coset shift*<w_N> of size N = n*lde_factor. Subcoset r of the
+    N-domain is shift*w_N^r*<w_n>; its size-n NTT lands at positions
+    j = r + q*L, so the (L, n) stack transposes straight into the
+    natural-order N-point table.
+
+Twiddle tables are cached per (log_n) on host (numpy powers) and baked
+into the jitted graphs as constants, mirroring NTTContext.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import babybear as bb
+
+
+def bitreverse_indices(n: int) -> np.ndarray:
+    bits = n.bit_length() - 1
+    idx = np.arange(n, dtype=np.uint32)
+    out = np.zeros(n, dtype=np.uint32)
+    for b in range(bits):
+        out |= ((idx >> b) & 1).astype(np.uint32) << (bits - 1 - b)
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _twiddles(log_n: int, inverse: bool):
+    """Per-stage DIT twiddle tables, natural-order radix-2: stage s
+    (half = 2^s) uses w_{2^(s+1)}^k for k < half."""
+    n = 1 << log_n
+    w = bb.omega(log_n)
+    if inverse:
+        w = bb.inv_s(w)
+    full = bb.powers_np(w, n // 2 if n > 1 else 1)
+    stages = []
+    for s in range(log_n):
+        half = 1 << s
+        step = n // (2 * half)
+        stages.append(np.ascontiguousarray(full[:: step][:half]))
+    return tuple(stages)
+
+
+def _ntt_core(x, log_n: int, inverse: bool):
+    """Iterative radix-2 over the last axis: bit-reverse permute then
+    log_n DIT butterfly stages (natural in, natural out)."""
+    n = 1 << log_n
+    if n == 1:
+        return x
+    brev = jnp.asarray(bitreverse_indices(n))
+    y = jnp.take(x, brev, axis=-1)
+    stages = _twiddles(log_n, inverse)
+    for s in range(log_n):
+        half = 1 << s
+        tw = jnp.asarray(stages[s])  # (half,)
+        y = y.reshape(y.shape[:-1] + (n // (2 * half), 2 * half))
+        even = y[..., :half]
+        odd = bb.mul(y[..., half:], tw)
+        y = jnp.concatenate([bb.add(even, odd), bb.sub(even, odd)], axis=-1)
+        y = y.reshape(y.shape[:-2] + (n,))
+    return y
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def values_from_monomial_bb(mono, log_n: int):
+    """Natural-order monomials -> natural-order subgroup evaluations."""
+    return _ntt_core(mono, log_n, inverse=False)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def monomial_from_values_bb(values, log_n: int):
+    """iNTT: natural-order evaluations -> monomial coefficients."""
+    y = _ntt_core(values, log_n, inverse=True)
+    n_inv = bb.inv_s(1 << log_n)
+    return bb.mul_const(y, n_inv)
+
+
+@functools.lru_cache(maxsize=None)
+def _lde_scale_table(log_n: int, lde_factor: int, shift: int):
+    """(L, n) scale rows: row r holds (shift * w_N^r)^i for i < n."""
+    n = 1 << log_n
+    N = n * lde_factor
+    w_big = bb.omega(N.bit_length() - 1)
+    rows = []
+    for r in range(lde_factor):
+        base = bb.mul_s(shift % bb.P, bb.pow_s(w_big, r))
+        rows.append(bb.powers_np(base, n))
+    return np.stack(rows)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def lde_from_monomial_bb(mono, log_n: int, lde_factor: int, shift: int):
+    """(..., n) monomials -> (..., N) natural-order coset evaluations,
+    N = n * lde_factor, domain shift*<w_N>. One scaled size-n NTT per
+    subcoset, interleaved by transpose."""
+    scale = jnp.asarray(_lde_scale_table(log_n, lde_factor, shift))
+    # (..., 1, n) * (L, n) -> (..., L, n)
+    scaled = bb.mul(mono[..., None, :], scale)
+    evals = _ntt_core(scaled, log_n, inverse=False)  # (..., L, n)
+    # position j = r + q*L <- subcoset r index q: transpose (L, n)->(n, L)
+    out = jnp.swapaxes(evals, -1, -2)
+    return out.reshape(out.shape[:-2] + ((1 << log_n) * lde_factor,))
+
+
+# ---------------------------------------------------------------------------
+# NumPy reference twins (compat/prove_reference_bb.py) — same layout
+# contract, pure host
+# ---------------------------------------------------------------------------
+
+
+def ntt_np(x: np.ndarray, inverse: bool) -> np.ndarray:
+    n = x.shape[-1]
+    log_n = n.bit_length() - 1
+    assert 1 << log_n == n
+    if n == 1:
+        return x.astype(np.uint32)
+    y = np.take(x.astype(np.uint32), bitreverse_indices(n), axis=-1)
+    stages = _twiddles(log_n, inverse)
+    for s in range(log_n):
+        half = 1 << s
+        tw = stages[s]
+        y = y.reshape(y.shape[:-1] + (n // (2 * half), 2 * half))
+        even = y[..., :half]
+        odd = bb.mul_np(y[..., half:], tw)
+        y = np.concatenate(
+            [bb.add_np(even, odd), bb.sub_np(even, odd)], axis=-1
+        )
+        y = y.reshape(y.shape[:-2] + (n,))
+    if inverse:
+        y = bb.mul_np(y, np.uint32(bb.inv_s(n)))
+    return y
+
+
+def lde_np(mono: np.ndarray, lde_factor: int, shift: int) -> np.ndarray:
+    n = mono.shape[-1]
+    log_n = n.bit_length() - 1
+    scale = _lde_scale_table(log_n, lde_factor, shift)
+    scaled = bb.mul_np(mono[..., None, :], scale)
+    evals = ntt_np(scaled, inverse=False)
+    out = np.swapaxes(evals, -1, -2)
+    return np.ascontiguousarray(out).reshape(
+        out.shape[:-2] + (n * lde_factor,)
+    )

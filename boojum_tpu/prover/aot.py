@@ -157,12 +157,17 @@ def variant_fingerprint(mesh_shape=None) -> dict:
     `precompile.enumerate_kernels` derives — resolved the same way the
     enumeration resolves it, so build and load can never disagree by
     parsing flags differently."""
+    from ..field.spec import active_field
     from ..utils import transfer as _transfer
     from .pallas_sweep import limb_resident_enabled, limb_sweep_enabled
     from .streaming import stream_threshold_bytes
 
     thresh = stream_threshold_bytes()
     return {
+        # the field backend selects a DISJOINT kernel set (`_bb` names,
+        # ISSUE 19) — a goldilocks bundle must never satisfy a babybear
+        # load or vice versa
+        "field": active_field(),
         "overlap": bool(_transfer.overlap_enabled()),
         "limb_sweep": bool(limb_sweep_enabled()),
         # the resident variant is a DISJOINT kernel set (`*_limbres`

@@ -1,0 +1,320 @@
+"""BabyBear plane-free kernel twins of the prover hot path (ISSUE 19).
+
+One u32 lane per field element end-to-end: the quotient sweep, the DEEP
+codeword and the FRI fold chain below never touch `field/limbs.py` — there
+are no (lo, hi) planes to split or join, so the interior-conversion
+counters (`limb.splits`/`limb.joins`) stay at ZERO by construction and
+every array moves HALF the HBM bytes of its limb-resident Goldilocks twin.
+
+Layout contract: everything is NATURAL order over the coset
+shift*<w_N>, N = n * lde_factor (ntt/bb_ntt.py). Extension values are
+4-tuples of base u32 arrays stacked to (4, ...) at kernel boundaries.
+
+Host-side tables (domain points, vanishing inverses, per-round fold
+twiddles) are lru_cached python/numpy — they depend only on the domain
+shape, never on witness data.
+
+Ledger names follow the variant-keyed pattern PR 9 set up
+(`coset_sweep_terms_bb`, `fri_fold_bb_k*`): prover/precompile.py
+enumerates exactly this set when BOOJUM_TPU_FIELD=babybear, and
+utils/costmodel.py prices the `_bb` names at 4 bytes/element.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import babybear as bb
+from ..field.spec import BABYBEAR as SPEC
+from ..hashes import poseidon2_bb as p2bb
+from ..ntt import bb_ntt
+
+INV2 = SPEC.half  # (p+1)/2 — satellite: read from the FieldSpec seam
+
+
+# ---------------------------------------------------------------------------
+# Host domain tables (witness-independent, cached per domain shape)
+# ---------------------------------------------------------------------------
+
+
+def _host_batch_inv(vals):
+    """Batch inverse of a uint32 numpy vector via Montgomery's trick on
+    python ints (one modular inversion total)."""
+    xs = [int(v) for v in vals]
+    pref = [1] * (len(xs) + 1)
+    for i, x in enumerate(xs):
+        pref[i + 1] = (pref[i] * x) % bb.P
+    acc = bb.inv_s(pref[-1])
+    out = [0] * len(xs)
+    for i in range(len(xs) - 1, -1, -1):
+        out[i] = (pref[i] * acc) % bb.P
+        acc = (acc * xs[i]) % bb.P
+    return np.array(out, dtype=np.uint32)
+
+
+@functools.lru_cache(maxsize=None)
+def domain_xs_bb(log_n: int, lde_factor: int, shift: int):
+    """Natural-order coset points x_j = shift * w_N^j, j < N."""
+    N = (1 << log_n) * lde_factor
+    w = bb.omega(N.bit_length() - 1)
+    return bb.mul_np(bb.powers_np(w, N), np.uint32(shift % bb.P))
+
+
+@functools.lru_cache(maxsize=None)
+def zh_inv_bb(log_n: int, lde_factor: int, shift: int):
+    """1 / (x_j^n - 1) over the coset. Z_H(x_j) = shift^n * w_L^(j mod L)
+    - 1 takes only L distinct values (w_N^n has order L), so the table is
+    L inversions tiled to N."""
+    n = 1 << log_n
+    L = lde_factor
+    sh_n = bb.pow_s(shift % bb.P, n)
+    wl = bb.omega(L.bit_length() - 1)
+    base = [
+        bb.sub_s(bb.mul_s(sh_n, bb.pow_s(wl, r)), 1) for r in range(L)
+    ]
+    return np.tile(_host_batch_inv(np.array(base, dtype=np.uint32)),
+                   n)
+
+
+@functools.lru_cache(maxsize=None)
+def last_row_term_bb(log_n: int, lde_factor: int, shift: int):
+    """(x_j - g^(n-1)) over the coset — the transition constraint's
+    excluded-row factor."""
+    g_last = bb.pow_s(bb.omega(log_n), (1 << log_n) - 1)
+    return bb.sub_np(domain_xs_bb(log_n, lde_factor, shift),
+                     np.uint32(g_last))
+
+
+@functools.lru_cache(maxsize=None)
+def boundary_inv_bb(log_n: int, lde_factor: int, shift: int):
+    """1 / (x_j - 1) over the coset (x = 1 is never on a proper coset,
+    so the subtraction never hits zero)."""
+    xs = domain_xs_bb(log_n, lde_factor, shift)
+    return _host_batch_inv(bb.sub_np(xs, np.uint32(1)))
+
+
+@functools.lru_cache(maxsize=None)
+def fri_fold_tables_bb(log_N: int, shift: int, num_rounds: int):
+    """Per-round odd-part twiddles: round r folds the length N_r = N>>r
+    codeword over shift^(2^r)*<w_{N_r}> by pairing j with j + N_r/2;
+    table[r][j] = 1 / (2 * x_j^(r)) for j < N_r/2 — the 1/2 of the even
+    part is folded into INV2 at the kernel."""
+    tables = []
+    for r in range(num_rounds):
+        log_r = log_N - r
+        half = 1 << (log_r - 1)
+        sh = bb.pow_s(shift % bb.P, 1 << r)
+        w = bb.omega(log_r)
+        xs = bb.mul_np(bb.powers_np(w, half), np.uint32(sh))
+        tables.append(_host_batch_inv(bb.mul_np(xs, np.uint32(2))))
+    return tuple(tables)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (the `_bb` ledger set)
+# ---------------------------------------------------------------------------
+
+
+def _ext_tuple(stacked):
+    """(4, ...) stacked array -> 4-tuple of base arrays."""
+    return tuple(stacked[k] for k in range(4))
+
+
+def _base_minus_ext(base_arr, e):
+    """(base - e) as an ext 4-tuple: coordinate 0 subtracts, coordinates
+    1..3 are the broadcast negations of e's."""
+    shape = base_arr.shape
+    return (
+        bb.sub(base_arr, jnp.broadcast_to(e[0], shape)),
+        jnp.broadcast_to(bb.neg(e[1]), shape),
+        jnp.broadcast_to(bb.neg(e[2]), shape),
+        jnp.broadcast_to(bb.neg(e[3]), shape),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(6,))
+def coset_sweep_terms_bb(
+    w_lde, alpha, c_pub, last_tbl, zh_inv_tbl, bnd_inv_tbl, lde_factor: int
+):
+    """The fused BabyBear quotient sweep over the LDE coset: transition
+    quotient (w(gx) - w(x)^2 - c) * (x - g_last) / Z_H(x) plus
+    alpha * boundary quotient (w(x) - pub) / (x - 1), emitted as the
+    ext quotient's 4 base coordinate columns (4, N).
+
+    w(g*x) on the natural-order coset is a roll by -L (g*x_j = x_{j+L}
+    mod N). `c_pub` is the (c, pub) public-parameter pair; the division
+    tables arrive precomputed (witness-independent)."""
+    wg = jnp.roll(w_lde, -lde_factor)
+    trans = bb.sub(wg, bb.add(bb.sqr(w_lde), c_pub[0]))
+    qt = bb.mul(bb.mul(trans, last_tbl), zh_inv_tbl)
+    qb = bb.mul(bb.sub(w_lde, c_pub[1]), bnd_inv_tbl)
+    out = [bb.add(qt, bb.mul(qb, alpha[0]))]
+    out += [bb.mul(qb, alpha[k]) for k in range(1, 4)]
+    return jnp.stack(out)
+
+
+@jax.jit
+def deep_accumulate_bb(
+    w_lde, q_cols, xs, z, gz, wz, wgz, qz, gammas
+):
+    """The BabyBear DEEP codeword (4, N): gamma-combined out-of-domain
+    quotients of every committed column, grouped by denominator —
+
+      [g0*(w - w(z)) + sum_k g_{2+k}*(Q_k - Q_k(z))] / (x - z)
+      + g1*(w - w(gz)) / (x - gz)
+
+    Denominator inverses are the vectorized Frobenius/norm ext inverse
+    (babybear.ext_inv) — no host round-trip, no limb planes."""
+    zt = _ext_tuple(z)
+    gzt = _ext_tuple(gz)
+    num = bb.ext_mul(_ext_tuple(gammas[0]), _base_minus_ext(w_lde, _ext_tuple(wz)))
+    for k in range(4):
+        num = bb.ext_add(
+            num,
+            bb.ext_mul(
+                _ext_tuple(gammas[2 + k]),
+                _base_minus_ext(q_cols[k], _ext_tuple(qz[k])),
+            ),
+        )
+    d1 = bb.ext_mul(num, bb.ext_inv(_base_minus_ext(xs, zt)))
+    shifted = bb.ext_mul(
+        _ext_tuple(gammas[1]), _base_minus_ext(w_lde, _ext_tuple(wgz))
+    )
+    d2 = bb.ext_mul(shifted, bb.ext_inv(_base_minus_ext(xs, gzt)))
+    return jnp.stack(bb.ext_add(d1, d2))
+
+
+@jax.jit
+def fri_fold_bb(codeword, beta, inv2x):
+    """One factor-2 natural-order fold of a (4, M) ext codeword:
+    f'(x^2) = (f(x) + f(-x))/2 + beta * (f(x) - f(-x))/(2x), pairing
+    j with j + M/2; `inv2x` is the precomputed base 1/(2x_j) table, so
+    the odd part costs 4 base muls before the single ext mul by beta."""
+    half = codeword.shape[-1] // 2
+    a = _ext_tuple(codeword[:, :half])
+    b = _ext_tuple(codeword[:, half:])
+    even = tuple(bb.mul_const(bb.add(x, y), INV2) for x, y in zip(a, b))
+    odd = tuple(bb.mul(bb.sub(x, y), inv2x) for x, y in zip(a, b))
+    out = bb.ext_add(even, bb.ext_mul(_ext_tuple(beta), odd))
+    return jnp.stack(out)
+
+
+# --- Merkle commit twins (digest = 8 u32 lanes) ----------------------------
+
+
+@jax.jit
+def leaf_digests_bb(cols):
+    """(B, N) committed columns -> (N, 8) BabyBear leaf digests; the
+    leaf-major transpose happens inside the graph (merkle.py idiom)."""
+    return p2bb._sponge_hash_bb(
+        cols.reshape(cols.shape[0], -1).T, p2bb.poseidon2_permutation_bb_xla
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def node_layers_bb(digests, cap_size: int):
+    """(N, 8) leaf digests -> all node layers up to the cap, one
+    dispatch, keyed only on (N, cap)."""
+    layers = [digests]
+    while layers[-1].shape[0] > cap_size:
+        cur = layers[-1]
+        layers.append(p2bb.node_hash_bb_xla(cur[0::2], cur[1::2]))
+    return tuple(layers)
+
+
+class BBMerkleTree:
+    """Cap-terminated Merkle tree over 8-lane BabyBear digests. Layers
+    are held as host numpy (the BB demo domains are tiny: <= 2^12 x 8
+    u32); the DEVICE work — leaf sponge + node stack — happened in the
+    backend's commit kernels before construction."""
+
+    def __init__(self, layers, cap_size: int):
+        self.layers = [np.asarray(l) for l in layers]
+        self.cap_size = cap_size
+        self.num_leaves = int(self.layers[0].shape[0])
+
+    def get_cap(self):
+        return [tuple(int(x) for x in row) for row in self.layers[-1]]
+
+    def get_path(self, leaf_idx: int):
+        path = []
+        idx = int(leaf_idx)
+        for layer in self.layers[:-1]:
+            path.append(tuple(int(x) for x in layer[idx ^ 1]))
+            idx >>= 1
+        return path
+
+
+def verify_path_bb(leaf_values, path, cap, leaf_idx: int) -> bool:
+    """Host-side BabyBear path verification against a cap."""
+    digest = p2bb.leaf_hash_bb_host([int(v) for v in leaf_values])
+    idx = int(leaf_idx)
+    for sib in path:
+        if idx & 1:
+            digest = p2bb.node_hash_bb_host(sib, digest)
+        else:
+            digest = p2bb.node_hash_bb_host(digest, sib)
+        idx >>= 1
+    return tuple(digest) == tuple(cap[idx])
+
+
+# ---------------------------------------------------------------------------
+# Precompile enumeration: the `_bb` kernel library
+# ---------------------------------------------------------------------------
+
+
+def bb_kernel_specs(log_n: int, lde_factor: int, cap_size: int) -> list:
+    """(name, jitted_fn, ShapeDtypeStruct args) triples for every
+    top-level executable a BabyBear prove of this domain dispatches —
+    the variant-keyed twin of fri_kernel_specs/enumerate_kernels, so
+    prover/precompile.py can lower/compile the `_bb` set concurrently.
+    No device memory is allocated."""
+
+    def u32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+    n = 1 << log_n
+    N = n * lde_factor
+    log_N = N.bit_length() - 1
+    num_folds = log_N - 5  # fold to a 32-point final codeword
+    specs = [
+        (f"imono_bb_n{n}",
+         bb_ntt.monomial_from_values_bb, (u32(n), log_n)),
+        (f"lde_bb_L{lde_factor}_n{n}",
+         bb_ntt.lde_from_monomial_bb,
+         (u32(n), log_n, lde_factor,
+          SPEC.multiplicative_generator)),
+        (f"leaf_digests_bb_n{N}x1", leaf_digests_bb, (u32(1, N),)),
+        (f"leaf_digests_bb_n{N}x4", leaf_digests_bb, (u32(4, N),)),
+        (f"node_layers_bb_n{N}", node_layers_bb, (u32(N, 8), cap_size)),
+        (f"coset_sweep_terms_bb_n{N}",
+         coset_sweep_terms_bb,
+         (u32(N), u32(4), u32(2), u32(N), u32(N), u32(N), lde_factor)),
+        (f"deep_accumulate_bb_n{N}",
+         deep_accumulate_bb,
+         (u32(N), u32(4, N), u32(N), u32(4), u32(4), u32(4), u32(4),
+          u32(4, 4), u32(6, 4))),
+    ]
+    cur = N
+    for r in range(num_folds):
+        specs.append(
+            (f"fri_fold_bb_k1_m{cur}",
+             fri_fold_bb, (u32(4, cur), u32(4), u32(cur // 2)))
+        )
+        if r + 1 < num_folds:
+            # the paired-leaf commit of the next layer: (cur/2, 8) rows
+            specs.append(
+                (f"leaf_digests_bb_n{cur // 4}x8",
+                 leaf_digests_bb, (u32(8, cur // 4),))
+            )
+            specs.append(
+                (f"node_layers_bb_n{cur // 4}",
+                 node_layers_bb, (u32(cur // 4, 8), min(cap_size,
+                                                        cur // 4)))
+            )
+        cur //= 2
+    return specs

@@ -1,0 +1,365 @@
+"""End-to-end BabyBear prover (ISSUE 19): a self-contained mini-STARK on
+bare u32 lanes, 2^10-scale, proved entirely through the `_bb` kernel twins.
+
+The statement: a length-n trace of the public square map
+w[i+1] = w[i]^2 + c with boundary w[0] = pub. One committed trace column,
+one alpha-combined ext quotient (4 base coordinate columns), DEEP at an
+ext point z, factor-2 natural-order FRI down to a raw final codeword,
+blake2s PoW, transcript-sampled queries — every round absorbing into the
+width-16 BabyBear Poseidon2 transcript and landing a Fiat–Shamir
+checkpoint, so checkpoint-stream determinism and NumPy-reference parity
+(compat/prove_reference_bb.py) are testable from day one.
+
+The prover is written against a small BACKEND seam (intt/lde/sweep/deep/
+fold/commit, numpy in, numpy out): the device backend dispatches the
+jitted `_bb` kernels (prover/bb_kernels.py); the reference backend is the
+same flow over pure-numpy twins. Transcript, challenge schedule, proof
+assembly and checkpoints are SHARED — parity is by construction, so a
+checkpoint mismatch always means a kernel bug, never a protocol drift.
+
+No `field/limbs.py` import anywhere on this path: the zero
+interior-conversion claim (`limb.splits == 0`) is structural.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from ..field import babybear as bb
+from ..field.spec import BABYBEAR as SPEC
+from ..transcript import BitSource, Poseidon2BabyBearTranscript
+from ..utils import metrics as _metrics
+from ..utils.report import checkpoint as _checkpoint
+from . import bb_kernels as K
+from .pow import blake2s_pow_grind
+
+
+@dataclasses.dataclass(frozen=True)
+class BBProofConfig:
+    log_n: int = 10
+    lde_factor: int = 4
+    shift: int = SPEC.multiplicative_generator
+    square_c: int = 7  # the transition constant of the square map
+    num_queries: int = 20
+    pow_bits: int = 8
+    cap_size: int = 8
+    final_len: int = 32  # raw FRI final codeword length
+
+    @property
+    def n(self) -> int:
+        return 1 << self.log_n
+
+    @property
+    def domain_len(self) -> int:
+        return self.n * self.lde_factor
+
+    @property
+    def num_folds(self) -> int:
+        return (self.domain_len // self.final_len).bit_length() - 1
+
+    def params_list(self) -> list:
+        return [
+            self.log_n, self.lde_factor, self.shift, self.square_c,
+            self.num_queries, self.pow_bits, self.cap_size, self.final_len,
+        ]
+
+
+@dataclasses.dataclass
+class BBProof:
+    config: BBProofConfig
+    pub: int
+    witness_cap: list
+    quotient_cap: list
+    evals: dict  # {"wz": ext, "wgz": ext, "qz": [ext x4]}
+    fri_caps: list  # caps of layers 1..num_folds-1
+    final_codeword: list  # final_len ext 4-tuples
+    pow_nonce: int
+    query_indices: list
+    queries: list  # per-query opening dicts
+
+
+# ---------------------------------------------------------------------------
+# Device backend: the `_bb` kernel twins (numpy in, numpy out)
+# ---------------------------------------------------------------------------
+
+
+class DeviceBackendBB:
+    """Dispatches the jitted plane-free kernels. All methods take and
+    return host numpy so the shared prover core never branches on the
+    backend; domains are 2^12-scale, transfers are noise."""
+
+    def intt(self, values):
+        import jax.numpy as jnp
+
+        values = np.asarray(values, dtype=np.uint32)
+        log_n = values.shape[-1].bit_length() - 1
+        from ..ntt.bb_ntt import monomial_from_values_bb
+
+        return np.asarray(monomial_from_values_bb(jnp.asarray(values), log_n))
+
+    def lde(self, mono, log_n, lde_factor, shift):
+        import jax.numpy as jnp
+
+        from ..ntt.bb_ntt import lde_from_monomial_bb
+
+        return np.asarray(
+            lde_from_monomial_bb(jnp.asarray(mono), log_n, lde_factor, shift)
+        )
+
+    def coset_sweep(self, w_lde, alpha, cfg: BBProofConfig, pub: int):
+        import jax.numpy as jnp
+
+        _metrics.count("quotient.bb_coset_sweeps")
+        args = (cfg.log_n, cfg.lde_factor, cfg.shift)
+        return np.asarray(
+            K.coset_sweep_terms_bb(
+                jnp.asarray(w_lde),
+                jnp.asarray(np.array(alpha, dtype=np.uint32)),
+                jnp.asarray(
+                    np.array([cfg.square_c, pub], dtype=np.uint32)
+                ),
+                jnp.asarray(K.last_row_term_bb(*args)),
+                jnp.asarray(K.zh_inv_bb(*args)),
+                jnp.asarray(K.boundary_inv_bb(*args)),
+                cfg.lde_factor,
+            )
+        )
+
+    def deep(self, w_lde, q_cols, xs, z, gz, wz, wgz, qz, gammas):
+        import jax.numpy as jnp
+
+        _metrics.count("deep.bb_accumulates")
+
+        def a(v):
+            return jnp.asarray(np.array(v, dtype=np.uint32))
+
+        return np.asarray(
+            K.deep_accumulate_bb(
+                jnp.asarray(w_lde), jnp.asarray(q_cols), jnp.asarray(xs),
+                a(z), a(gz), a(wz), a(wgz), a(qz), a(gammas),
+            )
+        )
+
+    def fold(self, codeword, beta, inv2x):
+        import jax.numpy as jnp
+
+        _metrics.count("fri.bb_folds")
+        return np.asarray(
+            K.fri_fold_bb(
+                jnp.asarray(codeword),
+                jnp.asarray(np.array(beta, dtype=np.uint32)),
+                jnp.asarray(inv2x),
+            )
+        )
+
+    def commit(self, cols, cap_size: int) -> K.BBMerkleTree:
+        import jax.numpy as jnp
+
+        _metrics.count("merkle.bb_commits")
+        digests = K.leaf_digests_bb(jnp.asarray(cols))
+        layers = K.node_layers_bb(digests, cap_size)
+        return K.BBMerkleTree([np.asarray(l) for l in layers], cap_size)
+
+
+# ---------------------------------------------------------------------------
+# Shared host helpers
+# ---------------------------------------------------------------------------
+
+
+def build_trace(pub: int, cfg: BBProofConfig):
+    """w[0] = pub, w[i+1] = w[i]^2 + c — natural-order subgroup values."""
+    w = [int(pub) % bb.P]
+    for _ in range(cfg.n - 1):
+        w.append((w[-1] * w[-1] + cfg.square_c) % bb.P)
+    return np.array(w, dtype=np.uint32)
+
+
+def ext_powers_table(z, count: int):
+    """(4, count) u32 table of ext powers 1, z, z^2, ... (host ints)."""
+    out = np.zeros((4, count), dtype=np.uint32)
+    cur = bb.ONE_S
+    for i in range(count):
+        for k in range(4):
+            out[k, i] = cur[k]
+        cur = bb.ext_mul_s(cur, z)
+    return out
+
+
+def eval_base_at_ext(mono, zpows) -> tuple:
+    """Evaluate a base-coefficient polynomial at the ext point whose
+    power table is `zpows` ((4, >=len) u32)."""
+    mono = np.asarray(mono, dtype=np.uint32)
+    m = mono.shape[-1]
+    return tuple(
+        int(
+            np.sum(
+                bb.mul_np(mono, zpows[k, :m]).astype(np.uint64)
+            ) % np.uint64(bb.P)
+        )
+        for k in range(4)
+    )
+
+
+def _flat_cap(cap) -> list:
+    return [int(v) for digest in cap for v in digest]
+
+
+def _flat_ext_list(vals) -> list:
+    return [int(c) for e in vals for c in e]
+
+
+def _fri_pair_cols(cur):
+    """(4, M) layer -> (8, M/2) paired-leaf columns: leaf j holds the
+    fold pair (f_j ‖ f_{j+M/2}), so one auth path serves both."""
+    half = cur.shape[-1] // 2
+    return np.vstack([cur[:, :half], cur[:, half:]])
+
+
+def coset_descale(mono_like, shift: int):
+    """Undo a coset: values over shift*<w_N> iNTT'd plainly give u with
+    u_i = m_i * shift^i; multiply by shift^-i to recover m."""
+    N = mono_like.shape[-1]
+    tbl = bb.powers_np(bb.inv_s(shift % bb.P), N)
+    return bb.mul_np(mono_like, tbl)
+
+
+# ---------------------------------------------------------------------------
+# The prover
+# ---------------------------------------------------------------------------
+
+
+def prove_babybear(
+    pub: int, cfg: BBProofConfig | None = None, backend=None
+) -> BBProof:
+    cfg = cfg or BBProofConfig()
+    backend = backend or DeviceBackendBB()
+    pub = int(pub) % bb.P
+    n, L, N = cfg.n, cfg.lde_factor, cfg.domain_len
+    log_N = N.bit_length() - 1
+
+    t = Poseidon2BabyBearTranscript()
+
+    # round 0: bind the protocol parameters + public input
+    params = cfg.params_list() + [pub]
+    t.witness_field_elements(params)
+    _checkpoint(0, "bb_params", params)
+
+    # round 1: trace -> monomials -> LDE -> witness commitment
+    w_vals = build_trace(pub, cfg)
+    w_mono = backend.intt(w_vals)
+    w_lde = backend.lde(w_mono, cfg.log_n, L, cfg.shift)
+    w_tree = backend.commit(w_lde[None, :], cfg.cap_size)
+    w_cap = w_tree.get_cap()
+    t.witness_merkle_tree_cap(w_cap)
+    _checkpoint(1, "witness_cap", _flat_cap(w_cap))
+
+    # round 2: the constraint-combining challenge
+    alpha = t.get_ext_challenge()
+    _checkpoint(2, "alpha", list(alpha))
+
+    # round 3: fused quotient sweep -> quotient commitment -> z
+    q_cols = backend.coset_sweep(w_lde, alpha, cfg, pub)
+    q_tree = backend.commit(q_cols, cfg.cap_size)
+    q_cap = q_tree.get_cap()
+    t.witness_merkle_tree_cap(q_cap)
+    _checkpoint(3, "quotient_cap", _flat_cap(q_cap))
+    z = t.get_ext_challenge()
+    _checkpoint(3, "z", list(z))
+
+    # round 4: out-of-domain evaluations at z and g*z
+    g = bb.omega(cfg.log_n)
+    gz = bb.ext_scale_s(z, g)
+    zpows = ext_powers_table(z, N)
+    gzpows = ext_powers_table(gz, n)
+    wz = eval_base_at_ext(w_mono, zpows)
+    wgz = eval_base_at_ext(w_mono, gzpows)
+    q_monos = coset_descale(backend.intt(q_cols), cfg.shift)
+    qz = [eval_base_at_ext(q_monos[k], zpows) for k in range(4)]
+    evals_flat = _flat_ext_list([wz, wgz] + qz)
+    t.witness_field_elements(evals_flat)
+    _checkpoint(4, "evals", evals_flat)
+    gammas = [t.get_ext_challenge() for _ in range(6)]
+    _checkpoint(4, "deep_gammas", _flat_ext_list(gammas))
+
+    # round 5: DEEP codeword -> FRI fold chain -> PoW -> queries
+    xs = K.domain_xs_bb(cfg.log_n, L, cfg.shift)
+    cur = backend.deep(
+        w_lde, q_cols, xs, z, gz, wz, wgz, qz, gammas
+    )
+    fold_tables = K.fri_fold_tables_bb(log_N, cfg.shift, cfg.num_folds)
+    fri_trees: list = []
+    fri_caps: list = []
+    betas: list = []
+    layers = [cur]
+    for r in range(cfg.num_folds):
+        if r > 0:
+            tree = backend.commit(_fri_pair_cols(cur), min(
+                cfg.cap_size, cur.shape[-1] // 2))
+            cap = tree.get_cap()
+            t.witness_merkle_tree_cap(cap)
+            _checkpoint(5, f"fri_cap_{r}", _flat_cap(cap))
+            fri_trees.append(tree)
+            fri_caps.append(cap)
+        beta = t.get_ext_challenge()
+        _checkpoint(5, f"fri_beta_{r}", list(beta))
+        betas.append(beta)
+        cur = backend.fold(cur, beta, fold_tables[r])
+        layers.append(cur)
+    final = [
+        tuple(int(cur[k, j]) for k in range(4))
+        for j in range(cfg.final_len)
+    ]
+    final_flat = _flat_ext_list(final)
+    t.witness_field_elements(final_flat)
+    _checkpoint(5, "fri_final", final_flat)
+
+    nonce = blake2s_pow_grind(t, cfg.pow_bits)
+    _checkpoint(5, "pow_nonce", [nonce])
+
+    bits = BitSource(log_N, challenge_bits=SPEC.challenge_bits)
+    idxs = [bits.get_index(t, log_N) for _ in range(cfg.num_queries)]
+    _checkpoint(5, "query_indices", idxs)
+
+    # query openings (host gathers over the stored trees/layers)
+    w_host = np.asarray(w_lde)
+    q_host = np.asarray(q_cols)
+    queries = []
+    for pos in idxs:
+        j0 = pos % (N // 2)
+        opens = {"pos": int(pos), "w": [], "q": [], "fri": []}
+        for j in (j0, j0 + N // 2):
+            opens["w"].append(
+                ([int(w_host[j])], w_tree.get_path(j))
+            )
+            opens["q"].append(
+                ([int(q_host[k, j]) for k in range(4)], q_tree.get_path(j))
+            )
+        p = j0
+        for r in range(1, cfg.num_folds):
+            M = N >> r
+            leaf_idx = p % (M // 2)
+            layer = layers[r]
+            leaf_vals = (
+                [int(layer[k, leaf_idx]) for k in range(4)]
+                + [int(layer[k, leaf_idx + M // 2]) for k in range(4)]
+            )
+            opens["fri"].append(
+                (leaf_vals, fri_trees[r - 1].get_path(leaf_idx))
+            )
+            p = p % (M // 2)
+        queries.append(opens)
+
+    return BBProof(
+        config=cfg,
+        pub=pub,
+        witness_cap=w_cap,
+        quotient_cap=q_cap,
+        evals={"wz": wz, "wgz": wgz, "qz": qz},
+        fri_caps=fri_caps,
+        final_codeword=final,
+        pow_nonce=int(nonce),
+        query_indices=[int(i) for i in idxs],
+        queries=queries,
+    )

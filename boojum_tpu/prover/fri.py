@@ -32,8 +32,9 @@ from ..ntt import (
     powers_device,
 )
 from .stages import ext_scalar
+from ..field.spec import GOLDILOCKS as _GL_SPEC
 
-INV2 = (gl.P + 1) // 2
+INV2 = _GL_SPEC.half  # (p + 1) / 2 — the fold's 1/2 (field/spec.py seam)
 
 
 from functools import lru_cache

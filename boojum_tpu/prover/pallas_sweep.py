@@ -120,9 +120,18 @@ def limb_resident_enabled() -> bool:
     pipeline with interpret-mode/XLA limb kernels — how the tier-1 parity
     tests run). Residency requires the limb kernel family, so every
     limb_sweep_enabled() veto (GSPMD mesh, force_xla, LIMB_SWEEP=0)
-    also disables it."""
+    also disables it.
+
+    BOOJUM_TPU_FIELD=babybear vetoes residency unconditionally (ISSUE
+    19): the (lo, hi) planes ARE the Goldilocks 64-bit representation —
+    a 31-bit BabyBear element is one bare u32 lane with no planes to be
+    resident in, and the dispatcher routes to the disjoint `_bb` kernel
+    set instead (prover/bb_kernels.py)."""
+    from ..field.spec import is_babybear
     from ..utils.transfer import env_flag_opt
 
+    if is_babybear():
+        return False
     explicit = env_flag_opt("BOOJUM_TPU_LIMB_RESIDENT")
     if explicit is False:
         return False

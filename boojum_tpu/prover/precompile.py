@@ -127,6 +127,15 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
         smm = mesh_shape  # an already-built Mesh
     D = SS.mesh_devices(smm) if smm is not None else 1
 
+    # field backend (ISSUE 19): BOOJUM_TPU_FIELD=babybear dispatches the
+    # plane-free `_bb` kernel set (prover/bb_kernels.py) — a third
+    # DISJOINT variant beside u64 and limb-resident, selected before
+    # either (the field also rides prover/aot.py's variant fingerprint)
+    from ..field.spec import is_babybear
+
+    if is_babybear():
+        return _enumerate_babybear(assembly, config)
+
     # limb residency (ISSUE 10): the resident prove dispatches a DISJOINT
     # plane-kernel set (`*_limbres` ledger names) — enumerate exactly that
     # set, never both (the variant also rides prover/aot.py's bundle key)
@@ -438,6 +447,24 @@ def enumerate_kernels(assembly, config, mesh_shape=None) -> list[KernelSpec]:
         seen.add(key)
         out.append(s)
     return out
+
+
+def _enumerate_babybear(assembly, config) -> list[KernelSpec]:
+    """The BabyBear plane-free kernel library (enumerate_kernels' `_bb`
+    twin, ISSUE 19): every top-level executable the self-contained
+    BabyBear prover leg (prover/bb_prover.py) dispatches at this shape
+    bucket's domain — single u32-lane args throughout, no (lo, hi)
+    plane pairs anywhere in the set."""
+    from .bb_kernels import bb_kernel_specs
+    from .shape_key import shape_bucket
+
+    sb = shape_bucket(assembly, config)
+    return [
+        KernelSpec(name, fn, args)
+        for name, fn, args in bb_kernel_specs(
+            sb.log_n, sb.lde_factor, sb.cap_size
+        )
+    ]
 
 
 def _enumerate_resident(assembly, config, smm, D) -> list[KernelSpec]:

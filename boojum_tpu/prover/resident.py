@@ -27,6 +27,15 @@ committed tree).
 Dispatch: `pallas_sweep.limb_resident_enabled()` — BOOJUM_TPU_LIMB_RESIDENT
 default ON where the limb sweep is native (TPU), `=0` restores the
 u64-resident path bit-for-bit, `=1` opts in on CPU (tier-1 parity tests).
+
+Field note (ISSUE 19): limb residency is a Goldilocks-only concern — the
+planes exist because Goldilocks elements are 64-bit and Mosaic has no
+64-bit integer datapath. Under `BOOJUM_TPU_FIELD=babybear` every element
+already fits one u32 lane, so there is nothing to split: the dispatcher
+(`precompile.enumerate_kernels`) selects the plane-free `_bb` kernel twins
+(prover/bb_kernels.py) before the limb-residency check, and
+`limb_resident_enabled()` itself returns False under babybear. No module
+here participates in a BabyBear prove.
 """
 
 from __future__ import annotations

@@ -125,6 +125,15 @@ class ShapeBucket:
             if self.fri_schedule
             else "sderived"
         )
+        # non-default field backends (ISSUE 19) suffix the key — their
+        # kernel shapes/dtypes are disjoint, so they must never share a
+        # cache or admission bucket with the Goldilocks set. Goldilocks
+        # keys stay BYTE-IDENTICAL to every key minted before the field
+        # seam existed (cached bundles/ledgers keep matching).
+        from ..field.spec import active_field
+
+        fld = active_field()
+        field_sfx = f":F{fld}" if fld != "goldilocks" else ""
         return (
             f"n2^{self.log_n}:L{self.lde_factor}:cap{self.cap_size}"
             f":q{self.quotient_degree}:Q{self.num_queries}"
@@ -132,6 +141,7 @@ class ShapeBucket:
             f":c{self.num_copy_cols}+{self.num_lookup_cols}"
             f"+{self.num_wit_cols}:k{self.num_constant_cols}"
             f":pi{self.num_public_inputs}:{lk}:g{self.gates_fp}"
+            f"{field_sfx}"
         )
 
     @property

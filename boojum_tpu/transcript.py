@@ -7,64 +7,83 @@ with a trailing 1) and its query-index bit buffer (`:369` BoolsBuffer). The
 transcript is inherently sequential and tiny, so it runs on host python ints;
 everything it absorbs (caps, evaluations) is read back from device once per
 round.
+
+Field genericity (ISSUE 19): every p-specific constant — the reduction
+modulus, the sponge width/rate, the absorb word width, the extension degree
+one `get_ext_challenge` spans — reads from a `field.spec.FieldSpec` class
+attribute. The Goldilocks defaults are BIT-IDENTICAL to the hardcoded
+originals; `Poseidon2BabyBearTranscript` is the same machine instantiated
+at the BabyBear record (width-16 permutation, 31-bit elements, degree-4
+ext challenges).
 """
 
 from .field import gl
+from .field.spec import BABYBEAR as _BB_SPEC
+from .field.spec import GOLDILOCKS as _GL_SPEC
 from .hashes.poseidon2 import poseidon2_permutation_host
 
 
 class Poseidon2Transcript:
     """Algebraic sponge transcript over a width-12 permutation; subclasses
     swap the permutation (the reference is generic over the round function
-    the same way, transcript.rs:48)."""
+    the same way, transcript.rs:48) and/or the FieldSpec."""
 
+    _SPEC = _GL_SPEC
     _PERMUTATION = staticmethod(poseidon2_permutation_host)
 
     def __init__(self):
-        self.state = [0] * 12
+        self.state = [0] * self._SPEC.sponge_width
         self.buffer = []
         self.available = []
 
     def witness_field_elements(self, els):
-        self.buffer.extend(int(e) % gl.P for e in els)
+        p = self._SPEC.p
+        self.buffer.extend(int(e) % p for e in els)
 
     def witness_merkle_tree_cap(self, cap):
         for digest in cap:
             self.witness_field_elements(digest)
 
     def get_challenge(self) -> int:
+        rate = self._SPEC.sponge_rate
         if not self.buffer:
             if self.available:
                 return self.available.pop(0)
             self.state = self._PERMUTATION(self.state)
-            self.available = list(self.state[:8])
+            self.available = list(self.state[:rate])
             return self.available.pop(0)
         # rescue-prime padding: trailing 1, then zeros to a multiple of rate
         to_absorb = self.buffer + [1]
         self.buffer = []
-        while len(to_absorb) % 8 != 0:
+        while len(to_absorb) % rate != 0:
             to_absorb.append(0)
-        for i in range(0, len(to_absorb), 8):
-            self.state[:8] = to_absorb[i : i + 8]
+        for i in range(0, len(to_absorb), rate):
+            self.state[:rate] = to_absorb[i : i + rate]
             self.state = self._PERMUTATION(self.state)
-        self.available = list(self.state[:8])
+        self.available = list(self.state[:rate])
         return self.available.pop(0)
 
     def get_multiple_challenges(self, n: int):
         return [self.get_challenge() for _ in range(n)]
 
     def get_ext_challenge(self):
-        c0 = self.get_challenge()
-        c1 = self.get_challenge()
-        return (c0, c1)
+        """One challenge per extension coordinate — a 2-tuple over
+        Goldilocks, a 4-tuple over BabyBear (where 31-bit base draws are
+        unsound and all protocol challenges live in GF(p^4))."""
+        return tuple(
+            self.get_challenge() for _ in range(self._SPEC.ext_degree)
+        )
 
 
 class _ByteTranscript:
     """Byte-oriented transcript base (reference Blake2sTranscript /
     Keccak256Transcript, transcript.rs:155,264): field elements are absorbed
-    as 8-byte LE words; on each challenge request the pending buffer is
-    folded into a running 32-byte seed, then challenges are squeezed as
-    `hash(seed ‖ counter_le4)` blocks, each 8-byte LE word reduced mod p."""
+    as `elem_bytes`-wide LE words (8 for Goldilocks); on each challenge
+    request the pending buffer is folded into a running 32-byte seed, then
+    challenges are squeezed as `hash(seed ‖ counter_le4)` blocks, each LE
+    word reduced mod p."""
+
+    _SPEC = _GL_SPEC
 
     def __init__(self):
         self.seed = b"\x00" * 32
@@ -76,14 +95,18 @@ class _ByteTranscript:
         raise NotImplementedError
 
     def witness_field_elements(self, els):
+        p = self._SPEC.p
+        width = self._SPEC.elem_bytes
         for e in els:
-            self.buffer += (int(e) % gl.P).to_bytes(8, "little")
+            self.buffer += (int(e) % p).to_bytes(width, "little")
 
     def witness_merkle_tree_cap(self, cap):
         for digest in cap:
             self.witness_field_elements(digest)
 
     def get_challenge(self) -> int:
+        p = self._SPEC.p
+        width = self._SPEC.elem_bytes
         if self.buffer:
             self.seed = self._hash(self.seed + bytes(self.buffer))
             self.buffer = bytearray()
@@ -95,8 +118,8 @@ class _ByteTranscript:
             )
             self.counter += 1
             self.available = [
-                int.from_bytes(block[i : i + 8], "little") % gl.P
-                for i in range(0, 32, 8)
+                int.from_bytes(block[i : i + width], "little") % p
+                for i in range(0, 32, width)
             ]
         return self.available.pop(0)
 
@@ -104,7 +127,9 @@ class _ByteTranscript:
         return [self.get_challenge() for _ in range(n)]
 
     def get_ext_challenge(self):
-        return (self.get_challenge(), self.get_challenge())
+        return tuple(
+            self.get_challenge() for _ in range(self._SPEC.ext_degree)
+        )
 
 
 class Blake2sTranscript(_ByteTranscript):
@@ -132,11 +157,36 @@ class PoseidonTranscript(Poseidon2Transcript):
     _PERMUTATION = staticmethod(_poseidon_perm)
 
 
+def _bb_permutation_host(state):
+    # lazy: hashes/poseidon2_bb drags in jax; the Goldilocks transcripts
+    # must stay importable without paying for the BabyBear backend
+    from .hashes.poseidon2_bb import poseidon2_permutation_bb_host
+
+    return poseidon2_permutation_bb_host(state)
+
+
+class Poseidon2BabyBearTranscript(Poseidon2Transcript):
+    """The BabyBear instantiation: width-16 permutation over p = 2^31 -
+    2^27 + 1, rate 8, degree-4 ext challenges (field/spec.py BABYBEAR)."""
+
+    _SPEC = _BB_SPEC
+    _PERMUTATION = staticmethod(_bb_permutation_host)
+
+
+class Blake2sBabyBearTranscript(Blake2sTranscript):
+    """Byte transcript at the BabyBear record: 4-byte LE absorb words,
+    8 challenge words per squeezed 32-byte block."""
+
+    _SPEC = _BB_SPEC
+
+
 TRANSCRIPTS = {
     "poseidon2": Poseidon2Transcript,
     "poseidon": PoseidonTranscript,
     "blake2s": Blake2sTranscript,
     "keccak256": Keccak256Transcript,
+    "poseidon2_babybear": Poseidon2BabyBearTranscript,
+    "blake2s_babybear": Blake2sBabyBearTranscript,
 }
 
 
@@ -147,19 +197,23 @@ def make_transcript(kind: str = "poseidon2"):
 class BitSource:
     """Uniform query-index bits drawn from transcript challenges.
 
-    Takes only the low (64 - max_needed) bits of each challenge for
-    uniformity, as the reference does (`transcript.rs:388`).
+    Takes only the low (challenge_bits - max_needed) bits of each
+    challenge for uniformity, as the reference does (`transcript.rs:388`).
+    `challenge_bits` is the field's challenge word width — 64 for
+    Goldilocks (the historical hardcode), 31 for BabyBear
+    (FieldSpec.challenge_bits).
     """
 
-    def __init__(self, max_needed_bits: int):
-        assert 0 < max_needed_bits < 64
+    def __init__(self, max_needed_bits: int, challenge_bits: int = 64):
+        assert 0 < max_needed_bits < challenge_bits
         self.bits = []
         self.max_needed = max_needed_bits
+        self.challenge_bits = challenge_bits
 
     def get_bits(self, transcript: Poseidon2Transcript, num_bits: int):
         while len(self.bits) < num_bits:
             c = transcript.get_challenge()
-            usable = 64 - self.max_needed
+            usable = self.challenge_bits - self.max_needed
             self.bits.extend((c >> i) & 1 for i in range(usable))
         out, self.bits = self.bits[:num_bits], self.bits[num_bits:]
         return out

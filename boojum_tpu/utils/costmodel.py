@@ -83,6 +83,19 @@ FOLD_BYTES_PER_ELEM = 220.0
 DEEP_FLOPS_PER_ELEM = 100.0
 DEEP_BYTES_PER_ELEM = 32.0
 
+# BabyBear (ISSUE 19): one u32 LANE per element, so every bytes term is
+# elem_bytes/8 of its Goldilocks twin — that factor-2 is the whole point
+# of the field backend and is pinned by tests/test_babybear.py. Flop
+# weights deliberately REUSE the u64-path calibration (a BabyBear mul is
+# one widening mul + mod, far under W_MUL): the `_bb` sheet's flops are
+# a conservative upper bound until a device calibration pass lands; its
+# bytes are exact per-lane.
+BB_ELEM_BYTES = 4.0
+# Poseidon2 t=16 BabyBear permutation: 8 full rounds (16 x^7 sboxes +
+# M4-block external MDS) + 13 partial rounds over a 64-byte u32 state
+P2BB_FLOPS_PER_PERM = 2600.0
+P2BB_BYTES_PER_PERM = 1500.0
+
 
 def _flops(muls: float, adds: float) -> float:
     return muls * W_MUL + adds * W_ADD
@@ -178,24 +191,27 @@ def device_peaks() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def ntt_cost(B: float, n: float) -> dict:
+def ntt_cost(B: float, n: float, elem_bytes: float = 8.0) -> dict:
     """One batched size-n (i)NTT over B columns: n/2·log2(n) butterflies
     per column (1 mul + 2 adds each) plus a scale pass; each of the
-    log2(n) stages re-reads and re-writes the full array."""
+    log2(n) stages re-reads and re-writes the full array. `elem_bytes`
+    is the field element's device footprint (8 for Goldilocks limbs, 4
+    for the BabyBear u32 lane)."""
     log_n = max(1.0, math.log2(max(n, 2)))
     muls = B * (n / 2) * log_n + B * n
     adds = B * n * log_n
-    bytes_ = 2.0 * B * n * 8 * log_n
+    bytes_ = 2.0 * B * n * elem_bytes * log_n
     return {"flops": _flops(muls, adds), "hbm_bytes": bytes_}
 
 
-def lde_cost(B: float, n: float, L: float) -> dict:
+def lde_cost(B: float, n: float, L: float,
+             elem_bytes: float = 8.0) -> dict:
     """LDE from monomials at rate L: per coset a scale pass (n muls/col)
     plus a forward size-n NTT."""
-    per = ntt_cost(B, n)
+    per = ntt_cost(B, n, elem_bytes)
     return {
         "flops": L * (per["flops"] + _flops(B * n, 0)),
-        "hbm_bytes": L * per["hbm_bytes"] + B * n * 8 * (L + 1),
+        "hbm_bytes": L * per["hbm_bytes"] + B * n * elem_bytes * (L + 1),
     }
 
 
@@ -218,16 +234,17 @@ def node_cost(N: float) -> dict:
     }
 
 
-def binv_cost(m: float) -> dict:
+def binv_cost(m: float, elem_bytes: float = 8.0) -> dict:
     """Batch inversion of m elements (per-element Fermat chain, as the
     XLA kernels lower it)."""
     return {
         "flops": m * BINV_FLOPS_PER_ELEM,
-        "hbm_bytes": m * BINV_BYTES_PER_ELEM,
+        "hbm_bytes": m * BINV_BYTES_PER_ELEM * (elem_bytes / 8.0),
     }
 
 
-def sweep_cost(domain: float, terms: float) -> dict:
+def sweep_cost(domain: float, terms: float,
+               elem_bytes: float = 8.0) -> dict:
     """The fused quotient sweep: `terms` alpha-weighted constraint terms
     evaluated over a `domain`-point coset domain, each an extension
     mul-accumulate on base-field operands."""
@@ -235,20 +252,20 @@ def sweep_cost(domain: float, terms: float) -> dict:
     adds = domain * terms * 3
     return {
         "flops": _flops(muls, adds),
-        "hbm_bytes": domain * terms * 8 * 0.5,
+        "hbm_bytes": domain * terms * elem_bytes * 0.5,
     }
 
 
-def deep_cost(cols: float, N: float) -> dict:
+def deep_cost(cols: float, N: float, elem_bytes: float = 8.0) -> dict:
     """DEEP quotient accumulation: per column an extension
     mul-accumulate against the inverted denominators over N points."""
     return {
         "flops": cols * N * DEEP_FLOPS_PER_ELEM,
-        "hbm_bytes": cols * N * DEEP_BYTES_PER_ELEM,
+        "hbm_bytes": cols * N * DEEP_BYTES_PER_ELEM * (elem_bytes / 8.0),
     }
 
 
-def fold_cost(m: float, k: int = 1) -> dict:
+def fold_cost(m: float, k: int = 1, elem_bytes: float = 8.0) -> dict:
     """One FRI 2^k-to-1 fold chain from domain size m: each of the k
     halvings is an extension mul-accumulate (plus deinterleave gathers)
     over the surviving half."""
@@ -257,7 +274,7 @@ def fold_cost(m: float, k: int = 1) -> dict:
     cur = m
     for _ in range(max(1, k)):
         flops += (cur / 2) * FOLD_FLOPS_PER_ELEM
-        bytes_ += (cur / 2) * FOLD_BYTES_PER_ELEM
+        bytes_ += (cur / 2) * FOLD_BYTES_PER_ELEM * (elem_bytes / 8.0)
         cur /= 2
     return {"flops": flops, "hbm_bytes": bytes_}
 
@@ -357,6 +374,11 @@ def kernel_cost(name: str, args, mesh_devices: int = 1) -> dict:
     family="fallback" — the tolerance cross-check only binds modeled
     families."""
     base = name.split(":", 1)[1] if ":" in name else name
+    if "_bb" in base:
+        # the BabyBear plane-free kernel set (prover/bb_kernels.py):
+        # single u32 lanes, so elements = bytes/4 and every bytes term
+        # scales by BB_ELEM_BYTES/8 against its Goldilocks twin
+        return _kernel_cost_bb(base, name, args)
     in_bytes = sum(_arg_bytes(a) for a in args)
     E = _main_elems(args)  # field elements of the dominant operand
     shapes = _arg_shapes(args)
@@ -485,6 +507,77 @@ def kernel_cost(name: str, args, mesh_devices: int = 1) -> dict:
         return fam("transfer", {"flops": 0.0, "hbm_bytes": in_bytes * 2.0})
     # generic elementwise estimate
     return fam("fallback", {"flops": E * 8.0, "hbm_bytes": in_bytes * 2.0})
+
+
+def _kernel_cost_bb(base: str, name: str, args) -> dict:
+    """Analytic cost of one `_bb` kernel dispatch. Same families as the
+    Goldilocks routing so the roofline and model_check aggregate them
+    together; every entry additionally carries field="babybear" and
+    elem_bytes=4 so a report consumer can attribute the byte halving."""
+    eb = BB_ELEM_BYTES
+    in_bytes = sum(_arg_bytes(a) for a in args)
+    shapes = _arg_shapes(args)
+    Bn = shapes[0] if shapes else (1, 1)
+    B = float(Bn[0]) if len(Bn) >= 2 else 1.0
+    n = float(Bn[-1]) if Bn else 1.0
+    E = max(
+        (float(_shape_elems(s)) for s in shapes), default=1.0
+    )  # elements of the dominant operand — u32 lanes, one per element
+
+    def fam(family: str, part: dict) -> dict:
+        return {
+            "flops": part.get("flops", 0.0),
+            "hbm_bytes": part.get("hbm_bytes", 0.0)
+            or float(in_bytes * 2),
+            "ici_bytes": 0.0,
+            "family": family,
+            "field": "babybear",
+            "elem_bytes": eb,
+        }
+
+    if base.startswith(("imono", "mono", "fwd", "ntt")):
+        return fam("ntt", ntt_cost(B, n, elem_bytes=eb))
+    if base.startswith("lde"):
+        return fam("lde", lde_cost(B, n, _lde_rate_from(name, shapes),
+                                   elem_bytes=eb))
+    if base.startswith("leaf_digests"):
+        # (B, N) columns -> N leaves of width B
+        rows = n if len(Bn) >= 2 else float(Bn[0])
+        perms = rows * max(1.0, math.ceil(B / P2_RATE))
+        return fam("sponge", {
+            "flops": perms * P2BB_FLOPS_PER_PERM,
+            "hbm_bytes": perms * P2BB_BYTES_PER_PERM,
+        })
+    if base.startswith("node_layers"):
+        leaves = float(Bn[0])
+        return fam("sponge", {
+            "flops": leaves * P2BB_FLOPS_PER_PERM,
+            "hbm_bytes": leaves * P2BB_BYTES_PER_PERM,
+        })
+    if base.startswith("coset_sweep_terms"):
+        domain = max((s[0] for s in shapes if len(s) == 1), default=n)
+        # transition + boundary over 4 ext coordinates
+        return fam("sweep", sweep_cost(float(domain), 8.0, elem_bytes=eb))
+    if base.startswith("deep_accumulate"):
+        N = max((float(s[-1]) for s in shapes if len(s) == 2), default=n)
+        cols = 1.0 + sum(
+            float(s[0]) for s in shapes if len(s) == 2 and s[-1] == N
+        )
+        return fam("deep", deep_cost(cols, N, elem_bytes=eb))
+    if base.startswith("fri_fold"):
+        return fam("fri", fold_cost(E / 4.0, _fold_k_from(name),
+                                    elem_bytes=eb))
+    if "binv" in base:
+        return fam("binv", binv_cost(E, elem_bytes=eb))
+    return fam("fallback", {"flops": E * 8.0,
+                            "hbm_bytes": in_bytes * 2.0})
+
+
+def _shape_elems(s) -> int:
+    n = 1
+    for d in s:
+        n *= int(d)
+    return n
 
 
 def _lde_rate_from(name: str, shapes) -> float:
@@ -775,8 +868,15 @@ def build_cost_record(
         if isinstance(wall, (int, float)):
             total_wall += wall
         _acc(total, entry)
+    try:
+        from ..field.spec import active_field
+
+        field_name = active_field()
+    except Exception:
+        field_name = "goldilocks"
     record: dict = {
         "schema": COST_SCHEMA,
+        "field": field_name,
         "device": peaks,
         "stages": rec_stages,
         "total": roofline(

@@ -54,6 +54,10 @@ ACCEPTED_SCHEMAS = (1, 2, 3, 4)
 TRACE_ID_RE = re.compile(r"^[0-9a-f]{32}$")
 SPAN_ID_RE = re.compile(r"^[0-9a-f]{16}$")
 
+# field backends (field/spec.py SPECS keys, ISSUE 19). Re-declared for
+# the same standalone-load reason as the id formats above.
+FIELD_NAMES = ("goldilocks", "babybear")
+
 # black-box forensics records (utils/blackbox.py): heartbeat/dump lines
 # interleave with prove lines in the same JSONL artifact; fleet records
 # are what `prove_report.py --fleet` emits from per-host artifacts.
@@ -734,6 +738,12 @@ def validate_report(report: dict) -> list[str]:
                 problems.append(
                     f"request prove_wall_s invalid: {pw!r}"
                 )
+            rf = request.get("field")
+            if rf is not None and rf not in FIELD_NAMES:
+                problems.append(
+                    f"request field {rf!r}: want one of "
+                    f"{sorted(FIELD_NAMES)}"
+                )
             if request.get("id") is not None:
                 span_request_ids.add(str(request["id"]))
     # per-tenant record (gateway lines, ISSUE 11): quota charges must be
@@ -839,6 +849,30 @@ def validate_report(report: dict) -> list[str]:
         problems.extend(
             _validate_cost(cost, report.get("compile_ledger"))
         )
+        # field-claim cross-check (ISSUE 19): the BabyBear backend's
+        # whole value is ONE u32 lane per element end-to-end — a line
+        # whose cost record claims field=babybear while the same line's
+        # counters record interior limb-plane conversions is running
+        # Goldilocks plumbing under a BabyBear label and must fail the
+        # gate (the limb.* counters only ever move on the (lo, hi)
+        # plane paths).
+        if isinstance(cost, dict) and cost.get("field") == "babybear":
+            m = report.get("metrics")
+            counters = (
+                m.get("counters")
+                if isinstance(m, dict)
+                and isinstance(m.get("counters"), dict)
+                else {}
+            )
+            for k in ("limb.splits", "limb.joins"):
+                v = counters.get(k, 0)
+                if isinstance(v, (int, float)) and v > 0:
+                    problems.append(
+                        f"cost record claims field=babybear but the "
+                        f"line counted {k} = {counters.get(k)} (limb "
+                        f"conversions are a Goldilocks-plane artifact "
+                        f"— the babybear path must never touch them)"
+                    )
     trace = report.get("trace")
     if trace is not None and not (
         isinstance(trace, dict) and isinstance(trace.get("dir"), str)
@@ -860,6 +894,12 @@ def _validate_cost(cost, ledger) -> list[str]:
     def _bad(v):
         return not isinstance(v, (int, float)) or v != v
 
+    field = cost.get("field")
+    if field is not None and field not in FIELD_NAMES:
+        problems.append(
+            f"cost record field {field!r}: want one of "
+            f"{sorted(FIELD_NAMES)}"
+        )
     stages = cost.get("stages")
     if not isinstance(stages, dict) or not stages:
         problems.append("cost record has no stages")

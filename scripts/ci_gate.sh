@@ -21,12 +21,19 @@
 # exports Chrome trace-event JSON, and the leg fails when the JSON is
 # invalid or the queue-wait span went missing.
 #
+# With --field, a smoke leg runs the BabyBear backend suite (ISSUE 19):
+# 2^10 e2e prove under BOOJUM_TPU_FIELD=babybear accepted by its own
+# verifier, deterministic Fiat-Shamir checkpoints across runs, zero
+# interior limb split/join conversions, and the `_bb` kernel set
+# enumerating/lowering + costing at half the Goldilocks HBM bytes.
+#
 # Exits nonzero when any requested leg fails. Knobs:
 #   CI_GATE_TIMEOUT_S     tier-1 budget in seconds (default 870, as in
 #                         ROADMAP.md; the -k kill grace stays 10 s)
 #   CI_GATE_THRESHOLD     relative regression threshold (default 0.2)
 #   CI_GATE_MH_TIMEOUT_S  --multihost leg budget in seconds (default 3600)
 #   CI_GATE_TL_TIMEOUT_S  --timeline leg budget in seconds (default 300)
+#   CI_GATE_FD_TIMEOUT_S  --field leg budget in seconds (default 870)
 set -u -o pipefail
 
 root="$(cd "$(dirname "$0")/.." && pwd)"
@@ -36,15 +43,18 @@ timeout_s="${CI_GATE_TIMEOUT_S:-870}"
 threshold="${CI_GATE_THRESHOLD:-0.2}"
 mh_timeout_s="${CI_GATE_MH_TIMEOUT_S:-3600}"
 tl_timeout_s="${CI_GATE_TL_TIMEOUT_S:-300}"
+fd_timeout_s="${CI_GATE_FD_TIMEOUT_S:-870}"
 multihost=0
 timeline=0
+fieldleg=0
 for arg in "$@"; do
     case "$arg" in
         --multihost) multihost=1 ;;
         --timeline) timeline=1 ;;
+        --field) fieldleg=1 ;;
         *)
             echo "ci_gate: unknown argument $arg" \
-                 "(supported: --multihost --timeline)" >&2
+                 "(supported: --multihost --timeline --field)" >&2
             exit 2
             ;;
     esac
@@ -130,6 +140,24 @@ PYEOF
         fi
     fi
     rm -rf "$tl_tmp"
+fi
+
+if [ "$fieldleg" -eq 1 ]; then
+    echo "== ci_gate: BabyBear field backend leg (budget ${fd_timeout_s}s) =="
+    # the suite itself sets/clears BOOJUM_TPU_FIELD per test; the env
+    # stays unset here so the Goldilocks-default tests in the same file
+    # see a clean process
+    timeout -k 10 "$fd_timeout_s" env JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_babybear.py -q \
+        --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
+    fd_rc=$?
+    if [ "$fd_rc" -ne 0 ]; then
+        echo "ci_gate: BabyBear field leg FAILED (rc=$fd_rc)"
+        rc=1
+    else
+        echo "ci_gate: BabyBear field leg ok"
+    fi
 fi
 
 if [ "$multihost" -eq 1 ]; then
