@@ -518,6 +518,15 @@ def _emit(status):
             "stages": _STATE["stages"] or _live_stage_split(),
             "peak_mem": _STATE["peak_mem"],
         }
+        # which field backend ran (ISSUE 20): a babybear line moves half
+        # the bytes of the same goldilocks geometry, so --trend /--slo
+        # must split series by field straight from the line
+        try:
+            from boojum_tpu.field.spec import active_field
+
+            out["field"] = active_field()
+        except Exception:
+            pass
         if _STATE["service"] is not None:
             out["service"] = _STATE["service"]
         if status != "ok":

@@ -137,3 +137,115 @@ def prove_babybear_reference(
 ) -> BBProof:
     """Run the shared BabyBear prover over the numpy kernel twins."""
     return prove_babybear(pub, cfg, backend=NumpyBackendBB())
+
+
+class NumpyBackendBBFull:
+    """Numpy twin of `prover.prover_bb.DeviceBackendBBFull` — the FULL
+    PLONKish prover's kernel seam (ISSUE 20): stage-2 grand product,
+    lookup polys, the fused gate/cp/lookup quotient sweep and the
+    multi-oracle DEEP all run the SAME `stages_bb` cores over the numpy
+    lib. A proof from this backend must be byte-identical to the device
+    backend's; divergence localizes to one kernel twin."""
+
+    name = "numpy"
+
+    def intt(self, values):
+        return bb_ntt.ntt_np(
+            np.asarray(values, dtype=np.uint32), inverse=True
+        )
+
+    def lde(self, mono, rate, shift=31):
+        return bb_ntt.lde_np(
+            np.asarray(mono, dtype=np.uint32), rate, shift
+        )
+
+    def commit(self, cols, cap_size: int) -> K.BBMerkleTree:
+        cols = np.asarray(cols, dtype=np.uint32)
+        digests = p2bb.leaf_hash_bb_np(cols.T)
+        layers = [digests]
+        while layers[-1].shape[0] > cap_size:
+            cur = layers[-1]
+            layers.append(p2bb.node_hash_bb_np(cur[0::2], cur[1::2]))
+        return K.BBMerkleTree(layers, cap_size)
+
+    def stage2(self, copy_vals, sigma_vals, ks, xs, beta, gamma, chunks):
+        from ..prover import stages_bb as S
+
+        return S.stage2_z_partials_np(
+            np.asarray(copy_vals, np.uint32),
+            np.asarray(sigma_vals, np.uint32),
+            tuple(int(k) for k in ks), np.asarray(xs, np.uint32),
+            beta, gamma, tuple(tuple(c) for c in chunks),
+        )
+
+    def lookup_polys(
+        self, lookup_cols, tid_col, table_cols, mults, lkb, lkg, R, width
+    ):
+        from ..prover import stages_bb as S
+
+        return S.lookup_polys_np(
+            np.asarray(lookup_cols, np.uint32),
+            np.asarray(tid_col, np.uint32),
+            np.asarray(table_cols, np.uint32),
+            np.asarray(mults, np.uint32), lkb, lkg, R, width,
+        )
+
+    def sweep(self, assembly, sweep_ctx, arrays):
+        from ..prover import stages_bb as S
+
+        gates, selector_paths, geometry, lk_ctx, non_residues = sweep_ctx
+        return S.full_sweep_np(
+            gates, selector_paths, geometry, lk_ctx, non_residues,
+            *[np.asarray(a, np.uint32) for a in arrays],
+        )
+
+    def deep(self, all_lde, zw_cols, lk_cols, pi_cols, xs, z4, zw4,
+             ch_tbl, at_z_const, y_zw, y_lk, pi_vals, pi_inv,
+             num_lk, num_pi):
+        from ..prover import stages_bb as S
+
+        return np.asarray(
+            S.deep_full_np(
+                np.asarray(all_lde, np.uint32),
+                np.asarray(zw_cols, np.uint32),
+                np.asarray(lk_cols, np.uint32),
+                np.asarray(pi_cols, np.uint32),
+                np.asarray(xs, np.uint32),
+                np.asarray(z4, np.uint32), np.asarray(zw4, np.uint32),
+                np.asarray(ch_tbl, np.uint32),
+                np.asarray(at_z_const, np.uint32),
+                np.asarray(y_zw, np.uint32), np.asarray(y_lk, np.uint32),
+                np.asarray(pi_vals, np.uint32),
+                np.asarray(pi_inv, np.uint32),
+                num_lk, num_pi,
+            )
+        )
+
+    def fri_fold(self, codeword, beta4, inv2x):
+        codeword = np.asarray(codeword, dtype=np.uint32)
+        inv2x = np.asarray(inv2x, dtype=np.uint32)
+        half = codeword.shape[-1] // 2
+        a = tuple(codeword[k, :half] for k in range(4))
+        b = tuple(codeword[k, half:] for k in range(4))
+        inv2 = np.uint32(K.INV2)
+        even = tuple(
+            bb.mul_np(bb.add_np(x, y), inv2) for x, y in zip(a, b)
+        )
+        odd = tuple(
+            bb.mul_np(bb.sub_np(x, y), inv2x) for x, y in zip(a, b)
+        )
+        out = bb.ext_add_np(
+            even, bb.ext_mul_np(_ext_cols(beta4), odd)
+        )
+        return np.stack(out)
+
+
+def prove_full_babybear_reference(assembly, setup, config):
+    """Run the shared FULL BabyBear prover over the numpy kernel twins
+    (same transcript, challenges, checkpoints and proof assembly as the
+    device leg — the core is `prover_bb.prove_full_babybear` itself)."""
+    from ..prover.prover_bb import prove_full_babybear
+
+    return prove_full_babybear(
+        assembly, setup, config, backend=NumpyBackendBBFull()
+    )

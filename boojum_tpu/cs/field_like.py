@@ -176,6 +176,44 @@ class BBArrayOps:
     double = staticmethod(bb.double)
 
 
+class BBNpArrayOps:
+    """BabyBear base-field ops over numpy uint32 arrays — the host twin of
+    BBArrayOps for the numpy reference backend's quotient sweep. Same gate
+    evaluators, same reduction discipline, pure numpy."""
+
+    @staticmethod
+    def zero():
+        import numpy as _np
+
+        return _np.uint32(0)
+
+    @staticmethod
+    def one():
+        import numpy as _np
+
+        return _np.uint32(1)
+
+    @staticmethod
+    def constant(v: int):
+        import numpy as _np
+
+        return _np.uint32(v % bb.P)
+
+    add = staticmethod(bb.add_np)
+    sub = staticmethod(bb.sub_np)
+    mul = staticmethod(bb.mul_np)
+
+    @staticmethod
+    def neg(a):
+        import numpy as _np
+
+        return bb.sub_np(_np.uint32(0), a)
+
+    @staticmethod
+    def double(a):
+        return bb.add_np(a, a)
+
+
 class BBExtScalarOps:
     """GF(p^4) ops over 4-tuples of python ints (BabyBear verifier at z)."""
 

@@ -9,7 +9,7 @@ register witness closures with the dataflow resolver, and place the instance.
 
 from __future__ import annotations
 
-from ...field import gl
+from ...field.active import field_p as _p, scalar_field as _fl
 from .base import Gate, RowView, TermsCollector
 
 
@@ -33,11 +33,12 @@ class FmaGate(Gate):
     def fma(cs, a, b, c, coeff_ab=1, coeff_c=1):
         """Allocate and constrain d = coeff_ab·a·b + coeff_c·c."""
         d = cs.alloc_variable_without_value()
-        ca, cc = coeff_ab % gl.P, coeff_c % gl.P
+        ca, cc = coeff_ab % _p(), coeff_c % _p()
 
         def resolve(vals):
+            f = _fl()
             av, bv, cv = vals
-            return [gl.add(gl.mul(ca, gl.mul(av, bv)), gl.mul(cc, cv))]
+            return [f.add(f.mul(ca, f.mul(av, bv)), f.mul(cc, cv))]
 
         from ...native import OP_FMA
 
@@ -51,7 +52,7 @@ class FmaGate(Gate):
     def enforce_fma(cs, a, b, c, d, coeff_ab=1, coeff_c=1):
         """Constrain coeff_ab·a·b + coeff_c·c = d over EXISTING variables
         (the reference's gate-with-rhs_part form, fma_gate_without_constant.rs)."""
-        ca, cc = coeff_ab % gl.P, coeff_c % gl.P
+        ca, cc = coeff_ab % _p(), coeff_c % _p()
         cs.place_gate(FmaGate.instance(), [a, b, c, d], (ca, cc))
 
     _inst = None
@@ -90,7 +91,7 @@ class ConstantsAllocatorGate(Gate):
     def allocate_constant(cs, value: int):
         from ...native import OP_CONST
 
-        value = value % gl.P
+        value = value % _p()
         v = cs.alloc_variable_without_value()
         cs.set_values_with_dependencies(
             [], [v], lambda _, value=value: [value],
@@ -212,12 +213,13 @@ class ReductionGate(Gate):
     def reduce(cs, vars4, coeffs4):
         assert len(vars4) == 4 and len(coeffs4) == 4
         out = cs.alloc_variable_without_value()
-        cf = [c % gl.P for c in coeffs4]
+        cf = [c % _p() for c in coeffs4]
 
         def resolve(vals):
+            f = _fl()
             acc = 0
             for v, c in zip(vals, cf):
-                acc = gl.add(acc, gl.mul(v, c))
+                acc = f.add(acc, f.mul(v, c))
             return [acc]
 
         from ...native import OP_REDUCTION
@@ -231,7 +233,7 @@ class ReductionGate(Gate):
     @staticmethod
     def enforce_reduce(cs, vars4, coeffs4, out):
         """Constrain sum coeff_i·x_i = out over EXISTING variables."""
-        cf = [c % gl.P for c in coeffs4]
+        cf = [c % _p() for c in coeffs4]
         cs.place_gate(ReductionGate.instance(), list(vars4) + [out], tuple(cf))
 
     _inst = None
@@ -264,13 +266,14 @@ class ReductionByPowersGate(Gate):
     @staticmethod
     def reduce(cs, vars4, base):
         out = cs.alloc_variable_without_value()
-        b = base % gl.P
+        b = base % _p()
 
         def resolve(vals):
+            f = _fl()
             acc, cp = 0, 1
             for v in vals:
-                acc = gl.add(acc, gl.mul(v, cp))
-                cp = gl.mul(cp, b)
+                acc = f.add(acc, f.mul(v, cp))
+                cp = f.mul(cp, b)
             return [acc]
 
         cs.set_values_with_dependencies(list(vars4), [out], resolve)
@@ -420,9 +423,10 @@ class DotProductGate(Gate):
         flat = [v for p in pairs for v in p]
 
         def resolve(vals):
+            f = _fl()
             acc = 0
             for i in range(4):
-                acc = gl.add(acc, gl.mul(vals[2 * i], vals[2 * i + 1]))
+                acc = f.add(acc, f.mul(vals[2 * i], vals[2 * i + 1]))
             return [acc]
 
         cs.set_values_with_dependencies(flat, [out], resolve)
@@ -496,7 +500,7 @@ class ZeroCheckGate(Gate):
             (xv,) = vals
             if xv == 0:
                 return [1, 0]
-            return [0, gl.inv(xv)]
+            return [0, _fl().inv(xv)]
 
         cs.set_values_with_dependencies([x], [out, aux], resolve)
         cs.place_gate(ZeroCheckGate.instance(), [x, out, aux], ())
@@ -545,7 +549,7 @@ class ZeroCheckWitnessGate(Gate):
             (xv,) = vals
             if xv == 0:
                 return [1, 0]
-            return [0, gl.inv(xv)]
+            return [0, _fl().inv(xv)]
 
         cs.set_values_with_dependencies([x], [out, aux], resolve)
         cs.place_gate(
@@ -686,10 +690,11 @@ class SimpleNonlinearityGate(Gate):
     @staticmethod
     def apply(cs, x, c: int):
         y = cs.alloc_variable_without_value()
-        c = c % gl.P
+        c = c % _p()
 
         def resolve(vals):
-            return [gl.add(gl.pow_(vals[0], 7), c)]
+            f = _fl()
+            return [f.add(f.pow_(vals[0], 7), c)]
 
         cs.set_values_with_dependencies([x], [y], resolve)
         cs.place_gate(SimpleNonlinearityGate.instance(), [x, y], (c,))
@@ -717,7 +722,7 @@ class MatrixMultiplicationGate(Gate):
 
     def __init__(self, name: str, matrix):
         self.name = f"matmul_{name}"
-        self.matrix = [[int(v) % gl.P for v in r] for r in matrix]
+        self.matrix = [[int(v) % _p() for v in r] for r in matrix]
         n = len(self.matrix)
         self.n = n
         self.principal_width = 2 * n
@@ -740,8 +745,9 @@ class MatrixMultiplicationGate(Gate):
         mat = self.matrix
 
         def resolve(vals):
+            f = _fl()
             return [
-                sum(gl.mul(mat[i][j], vals[j]) for j in range(self.n)) % gl.P
+                sum(f.mul(mat[i][j], vals[j]) for j in range(self.n)) % f.P
                 for i in range(self.n)
             ]
 
@@ -762,7 +768,7 @@ class ExplicitConstantsAllocatorGate(Gate):
     max_degree = 1
 
     def __init__(self, constants_set=()):
-        consts = [0, 1, gl.P - 1] + [int(c) % gl.P for c in constants_set]
+        consts = [0, 1, _p() - 1] + [int(c) % _p() for c in constants_set]
         self.constants = consts
         self.principal_width = len(consts)
         self.num_terms = len(consts)

@@ -14,6 +14,16 @@ from .base import Gate
 SHIFT32 = 1 << 32
 
 
+def _require_capacity(cs, bits: int, gate_name: str) -> None:
+    """Field-capacity guard (ISSUE 20): these gates assume every `bits`-bit
+    integer is a distinct field element (e.g. the u32 fma relation
+    a·b + c + cin = low + 2^32·high needs 2^32 < p). Synthesizing them
+    over a too-small backend (BabyBear, p ≈ 2^31) must fail loudly."""
+    require = getattr(cs, "require_field_bits", None)
+    if require is not None:
+        require(bits, f"{gate_name} (fixed-width integer arithmetic)")
+
+
 class U32AddGate(Gate):
     """a + b + carry_in = c + 2^32·carry_out; carry_out boolean."""
 
@@ -31,6 +41,7 @@ class U32AddGate(Gate):
 
     @staticmethod
     def add(cs, a, b, carry_in):
+        _require_capacity(cs, 32, "U32AddGate")
         c = cs.alloc_variable_without_value()
         cout = cs.alloc_variable_without_value()
 
@@ -73,6 +84,7 @@ class U32SubGate(Gate):
 
     @staticmethod
     def sub(cs, a, b, borrow_in):
+        _require_capacity(cs, 32, "U32SubGate")
         c = cs.alloc_variable_without_value()
         bout = cs.alloc_variable_without_value()
 
@@ -141,6 +153,7 @@ class U32FmaGate(Gate):
 
     @staticmethod
     def fma(cs, a, b, c, carry_in):
+        _require_capacity(cs, 32, "U32FmaGate")
         outs = cs.alloc_multiple_variables_without_values(7)
         a_lo, a_hi, b_lo, b_hi, low, high, k = outs
 
@@ -199,6 +212,7 @@ class U32TriAddCarryAsChunkGate(Gate):
 
     @staticmethod
     def add(cs, a, b, c):
+        _require_capacity(cs, 32, "U32TriAddCarryAsChunkGate")
         low = cs.alloc_variable_without_value()
         high = cs.alloc_variable_without_value()
 
@@ -249,6 +263,7 @@ class ByteTriAddGate(Gate):
     @staticmethod
     def add(cs, a4, b4, x4):
         """(out4, carry): bytes of (a + b + x) mod 2^32 plus the carry chunk."""
+        _require_capacity(cs, 32, "ByteTriAddGate")
         outs = cs.alloc_multiple_variables_without_values(4)
         carry = cs.alloc_variable_without_value()
         ins = list(a4) + list(b4) + list(x4)
@@ -302,6 +317,7 @@ class UIntXAddGate(Gate):
         dst.push(ops.sub(ops.mul(cout, cout), cout))
 
     def add(self, cs, a, b, carry_in):
+        _require_capacity(cs, self.width_bits, "UIntXAddGate")
         c = cs.alloc_variable_without_value()
         cout = cs.alloc_variable_without_value()
         mask = self.shift - 1

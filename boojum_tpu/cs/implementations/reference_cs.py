@@ -20,10 +20,21 @@ from __future__ import annotations
 import numpy as np
 
 from ...field import gl
+from ...field.active import field_p
+from ...field.spec import active_field
 from ..types import CSGeometry, CSConfig, DEV_CS_CONFIG, LookupParameters
 from ...dag import NullResolver, make_resolver
 from ..gates.base import Gate
 from ..gates.simple import ConstantsAllocatorGate
+
+
+class FieldCapacityError(ValueError):
+    """A gadget's arithmetic does not fit the active field backend.
+
+    Raised at SYNTHESIS time (not at prove time, where the broken witness
+    would only surface as an unsatisfiable trace): e.g. sha256's u32
+    decomposition gates need every 32-bit value to be a distinct field
+    element, which BabyBear (p = 2^31 - 2^27 + 1) cannot represent."""
 
 
 class ConstraintSystem:
@@ -39,6 +50,13 @@ class ConstraintSystem:
         self.max_trace_len = max_trace_len
         self.config = config
         self.lookup_params = lookup_params or LookupParameters()
+        # field backend binding (ISSUE 20): the circuit is synthesized OVER
+        # a field — witness values, gate constants and resolver arithmetic
+        # all reduce mod this prime, and the frozen assembly carries the
+        # name so a prove under a different BOOJUM_TPU_FIELD fails loudly
+        # instead of producing an unsatisfiable trace.
+        self.field = active_field()
+        self._field_p = field_p()
         if resolver is not None:
             self.resolver = resolver
         else:
@@ -88,7 +106,7 @@ class ConstraintSystem:
 
     def alloc_variable_with_value(self, value: int) -> int:
         p = self.alloc_variable_without_value()
-        self.resolver.set_value(p, value % gl.P)
+        self.resolver.set_value(p, value % self._field_p)
         return p
 
     def set_values_with_dependencies(self, ins, outs, fn, native=None, table=None):
@@ -99,6 +117,21 @@ class ConstraintSystem:
 
     def get_value(self, place: int) -> int:
         return self.resolver.get_value(place)
+
+    def require_field_bits(self, bits: int, feature: str) -> None:
+        """Field-capacity guard (ISSUE 20): assert the active field can
+        hold every value in [0, 2^bits) as a distinct element. Gadgets
+        whose arithmetic assumes b-bit integers (u32 decompositions, byte
+        tables) call this at synthesis so e.g. sha256-over-babybear fails
+        with a clear error instead of a silently wrapped witness."""
+        if (1 << bits) > self._field_p:
+            raise FieldCapacityError(
+                f"{feature} needs {bits}-bit values as single field "
+                f"elements, but the active field backend "
+                f"{self.field!r} has p = {self._field_p} "
+                f"(< 2^{bits}); this circuit is only supported over a "
+                f"larger field (e.g. goldilocks — unset BOOJUM_TPU_FIELD)"
+            )
 
     # -- canonical constants ------------------------------------------------
 
@@ -118,7 +151,7 @@ class ConstraintSystem:
         free, and hash gadgets re-request the same round constants heavily
         (the reference amortizes these per-row via tooling instead,
         constant_allocator.rs)."""
-        value = value % gl.P
+        value = value % self._field_p
         v = self._constants_cache.get(value)
         if v is None:
             v = ConstantsAllocatorGate.allocate_constant(self, value)
@@ -163,7 +196,9 @@ class ConstraintSystem:
             self.next_row += 1
             self.row_gate[row] = gid
             if constants:
-                self.gate_constants[row] = tuple(int(c) % gl.P for c in constants)
+                self.gate_constants[row] = tuple(
+                    int(c) % self._field_p for c in constants
+                )
             tool = [row, 0]
             self._tooling[key] = tool
         row, used = tool
@@ -447,6 +482,7 @@ class ConstraintSystem:
         return CSAssembly(
             geometry=self.geometry,
             lookup_params=self.lookup_params,
+            field=self.field,
             trace_len=n,
             gates=self.gates,
             row_gate=self.row_gate[:n].copy(),

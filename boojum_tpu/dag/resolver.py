@@ -367,7 +367,16 @@ class PlaybackResolver(WitnessResolver):
 
 def make_resolver(capacity: int = 1 << 16) -> WitnessResolver:
     """The default witness resolver: native tape engine when the C++ library
-    is available (BOOJUM_TPU_NO_NATIVE=1 opts out), else pure python."""
+    is available (BOOJUM_TPU_NO_NATIVE=1 opts out), else pure python.
+
+    The native tape computes in GOLDILOCKS (its typed ops hardwire the
+    2^64-2^32+1 reduction), so any other active field backend (ISSUE 20:
+    BOOJUM_TPU_FIELD=babybear) takes the portable python resolver, whose
+    closures dispatch through field/active.py."""
+    from ..field.spec import active_field
+
+    if active_field() != "goldilocks":
+        return WitnessResolver(capacity=capacity)
     from ..native import get_lib
 
     lib = get_lib()

@@ -13,6 +13,10 @@ from .cs.types import CSGeometry, LookupParameters
 from .cs.implementations import ConstraintSystem
 from .cs.lookup_table import LookupTable, range_check_table
 from .cs.gates import FmaGate, PublicInputGate
+from .cs.gates.simple import (
+    MatrixMultiplicationGate,
+    SimpleNonlinearityGate,
+)
 
 EXAMPLE_GEOMETRY = CSGeometry(
     num_columns_under_copy_permutation=8,
@@ -56,3 +60,55 @@ def build_xor_lookup_circuit(
         last_out = out
     PublicInputGate.place(cs, acc)
     return cs, acc, last_out
+
+
+def build_fma_chain_circuit(
+    num_rows: int = (1 << 10) - 8,
+    geometry: CSGeometry = EXAMPLE_GEOMETRY,
+    capacity: int = 1 << 10,
+):
+    """A Fibonacci-style fma chain with one public input: the minimal
+    every-round circuit (no lookups). Field-agnostic arithmetic — the
+    canonical e2e leg for alternative field backends (ISSUE 20).
+
+    Returns (cs, out_var).
+    """
+    cs = ConstraintSystem(geometry, capacity)
+    a = cs.alloc_variable_with_value(1)
+    b = cs.alloc_variable_with_value(2)
+    per_row = FmaGate.instance().num_repetitions(geometry)
+    for _ in range(num_rows * per_row):
+        a, b = b, FmaGate.fma(cs, a, b, a, 1, 1)
+    PublicInputGate.place(cs, b)
+    return cs, b
+
+
+def build_poseidon_rf_circuit(
+    num_rounds: int = 48,
+    geometry: CSGeometry = EXAMPLE_GEOMETRY,
+    capacity: int = 1 << 10,
+    seed: int = 11,
+):
+    """A toy Poseidon-style round function: width-3 state, per round a
+    degree-7 S-box with a round constant followed by a circulant MDS mix
+    (SimpleNonlinearityGate + MatrixMultiplicationGate — the same gate
+    shapes real Poseidon circuits use). Degree-7 constraints push the
+    quotient degree to 8, exercising the decoupled sweep rate; all
+    arithmetic fits any backend field (ISSUE 20's poseidon-rf e2e leg).
+
+    Returns (cs, out_var).
+    """
+    cs = ConstraintSystem(geometry, capacity)
+    rng = np.random.default_rng(seed)
+    mds = MatrixMultiplicationGate(
+        "rf3", [[2, 1, 1], [1, 2, 1], [1, 1, 2]]
+    )
+    state = [cs.alloc_variable_with_value(int(v)) for v in (3, 5, 7)]
+    for _ in range(num_rounds):
+        sboxed = [
+            SimpleNonlinearityGate.apply(cs, x, int(rng.integers(1, 997)))
+            for x in state
+        ]
+        state = mds.apply(cs, sboxed)
+    PublicInputGate.place(cs, state[0])
+    return cs, state[0]

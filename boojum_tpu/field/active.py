@@ -1,0 +1,48 @@
+"""Active scalar-field dispatch for HOST-side synthesis arithmetic.
+
+The CS layer (witness resolvers, constant reduction, gate coefficient
+normalization) historically hardwired Goldilocks (`field/gl.py`). With the
+BabyBear backend driving the full prover (ISSUE 20), every host scalar op
+the synthesis path performs must reduce mod the ACTIVE field's prime or
+the witness itself is wrong — an fma chain computed mod 2^64-2^32+1 is
+not a valid BabyBear trace.
+
+`scalar_field()` returns a namespace with the handful of host ops the CS
+layer uses (`P`, `add`, `sub`, `mul`, `neg`, `inv`, `pow_`). For
+Goldilocks it returns `field/gl.py` ITSELF, so the default path is
+byte-identical to the pre-ISSUE-20 behavior; for BabyBear it returns a
+thin shim over `field/babybear.py`'s `*_s` host scalars. Resolution reads
+``BOOJUM_TPU_FIELD`` at CALL time (like `field/spec.py`), so tests can
+flip the backend per-case.
+"""
+
+from __future__ import annotations
+
+from . import gl
+from .spec import active_field
+
+
+class _BabyBearScalars:
+    """Host scalar ops shim matching field/gl.py's names."""
+
+    from . import babybear as _bb
+
+    P = _bb.P
+    add = staticmethod(_bb.add_s)
+    sub = staticmethod(_bb.sub_s)
+    mul = staticmethod(_bb.mul_s)
+    neg = staticmethod(_bb.neg_s)
+    inv = staticmethod(_bb.inv_s)
+    pow_ = staticmethod(_bb.pow_s)
+
+
+def scalar_field():
+    """The active field's host scalar namespace (gl module or BB shim)."""
+    if active_field() == "babybear":
+        return _BabyBearScalars
+    return gl
+
+
+def field_p() -> int:
+    """The active field's prime (synthesis-time constant reduction)."""
+    return scalar_field().P

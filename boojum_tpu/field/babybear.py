@@ -101,14 +101,18 @@ def mul_np(a, b):
 
 
 def add_np(a, b):
+    # conditional-subtract written without an underflowing where-branch so
+    # numpy scalar inputs (reference-backend ext ops) stay warning-free
     s = np.asarray(a, dtype=np.uint32) + np.asarray(b, dtype=np.uint32)
-    return np.where(s >= _P32, s - _P32, s)
+    return s - np.where(s >= _P32, _P32, np.uint32(0))
 
 
 def sub_np(a, b):
-    a = np.asarray(a, dtype=np.uint32)
-    b = np.asarray(b, dtype=np.uint32)
-    return np.where(a >= b, a - b, a + (_P32 - b))
+    # a + (p - b) < 2^32 for canonical inputs; fold back with one cond-sub
+    r = np.asarray(a, dtype=np.uint32) + (
+        _P32 - np.asarray(b, dtype=np.uint32)
+    )
+    return r - np.where(r >= _P32, _P32, np.uint32(0))
 
 
 def powers_np(base: int, count: int):
@@ -453,3 +457,46 @@ def ext_inv_np(a):
     )
     ninv = inv_np(norm)
     return tuple(mul_np(x, ninv) for x in t)
+
+
+def ext_prefix_product(a):
+    """Inclusive prefix products of a GF(p^4) vector (4-tuple of device
+    arrays) along the last axis — Hillis–Steele doubling with ext_mul,
+    the extension twin of prefix_product (ISSUE 20 stage-2 z column)."""
+    n = a[0].shape[-1]
+    steps = max(1, (n - 1).bit_length())
+    y = a
+    for s in range(steps):
+        shift = 1 << s
+        shifted = tuple(
+            jnp.concatenate(
+                [
+                    (jnp.ones_like if k == 0 else jnp.zeros_like)(
+                        y[k][..., :shift]
+                    ),
+                    y[k][..., :-shift],
+                ],
+                axis=-1,
+            )
+            for k in range(4)
+        )
+        y = ext_mul(y, shifted)
+    return y
+
+
+def ext_prefix_product_np(a):
+    """Sequential numpy twin of ext_prefix_product (reference backend)."""
+    n = int(a[0].shape[-1])
+    out = tuple(np.empty_like(x) for x in a)
+    shape = a[0][..., :1].shape
+    cur = (
+        np.ones(shape, dtype=np.uint32),
+        np.zeros(shape, dtype=np.uint32),
+        np.zeros(shape, dtype=np.uint32),
+        np.zeros(shape, dtype=np.uint32),
+    )
+    for j in range(n):
+        cur = ext_mul_np(cur, tuple(x[..., j : j + 1] for x in a))
+        for k in range(4):
+            out[k][..., j : j + 1] = cur[k]
+    return out

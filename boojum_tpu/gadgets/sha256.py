@@ -63,6 +63,12 @@ class _Sha256Ctx:
     """Per-circuit handles: table ids + shared constants."""
 
     def __init__(self, cs):
+        # sha256 words are u32 variables — one field element per 32-bit
+        # value. BabyBear (p ≈ 2^31) cannot represent them; fail at
+        # synthesis with a clear error (ISSUE 20 field-capacity guard).
+        require = getattr(cs, "require_field_bits", None)
+        if require is not None:
+            require(32, "sha256 gadget")
         ids = register_sha256_tables(cs)
         self.cs = cs
         self.trixor = ids["trixor4"]

@@ -459,12 +459,67 @@ def _enumerate_babybear(assembly, config) -> list[KernelSpec]:
     from .shape_key import shape_bucket
 
     sb = shape_bucket(assembly, config)
-    return [
+    specs = [
         KernelSpec(name, fn, args)
         for name, fn, args in bb_kernel_specs(
             sb.log_n, sb.lde_factor, sb.cap_size
         )
     ]
+    specs += _enumerate_babybear_full(sb)
+    return specs
+
+
+def _enumerate_babybear_full(sb) -> list[KernelSpec]:
+    """The FULL BabyBear prover's assembly-independent executables
+    (ISSUE 20, prover/prover_bb.py): batched u32 iNTT/LDE at the
+    bucket's oracle widths, paired-leaf commits at every oracle's
+    (2B, N/2) stack, and the factor-2 FRI fold chain. The fused gate
+    sweep jit is assembly-shaped (gate evaluators are baked into the
+    graph) and warms on first prove instead."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ntt.bb_ntt import lde_from_monomial_bb, monomial_from_values_bb
+    from .bb_kernels import leaf_digests_bb, node_layers_bb, fri_fold_bb
+
+    def u32(*shape):
+        return jax.ShapeDtypeStruct(shape, jnp.uint32)
+
+    n, L, cap = sb.trace_len, sb.lde_factor, sb.cap_size
+    log_n = sb.log_n
+    N = n * L
+    half = N // 2
+    Q = sb.quotient_degree
+    shift = 31
+    specs: list[KernelSpec] = []
+
+    def add(name, fn, *args):
+        specs.append(KernelSpec(name, fn, args))
+
+    zs_rows = 4  # the z poly's base columns (omega-shifted monomials)
+    oracle_widths = sorted(
+        {sb.B_wit, sb.S, sb.B_q, zs_rows, 4}  # 4 = DEEP/FRI codeword
+    )
+    for B in oracle_widths:
+        if B <= 0:
+            continue
+        add(f"imono_bb_n{n}x{B}", monomial_from_values_bb,
+            u32(B, n), log_n)
+        add(f"lde_bb_L{L}_n{n}x{B}", lde_from_monomial_bb,
+            u32(B, n), log_n, L, shift)
+        add(f"leaf_digests_bb_n{half}x{2 * B}", leaf_digests_bb,
+            u32(2 * B, half))
+    add(f"node_layers_bb_n{half}", node_layers_bb, u32(half, 8),
+        min(cap, half))
+    # rate-Q sweep-domain evals of every committed oracle group
+    for B in sorted({sb.B_wit, sb.B_setup, sb.S, zs_rows}):
+        if B > 0:
+            add(f"lde_bb_Q{Q}_n{n}x{B}", lde_from_monomial_bb,
+                u32(B, n), log_n, Q, shift)
+    # quotient interpolation over the rate-Q accumulator
+    add(f"imono_bb_n{Q * n}x4", monomial_from_values_bb,
+        u32(4, Q * n), (Q * n).bit_length() - 1)
+    return specs
 
 
 def _enumerate_resident(assembly, config, smm, D) -> list[KernelSpec]:

@@ -1118,7 +1118,16 @@ def _prove_entry(assembly, setup, config: ProofConfig, mesh) -> Proof:
 
             _aot.maybe_load_for_prove(assembly, config, mesh)
         try:
-            if mesh is not None:
+            from ..field.spec import is_babybear
+
+            if is_babybear():
+                # ISSUE 20: the BabyBear field backend drives the REAL
+                # prover pipeline — same rounds, checkpoints and clock
+                # stages, every kernel the plane-free u32 twin
+                from .prover_bb import prove_full_babybear
+
+                proof = prove_full_babybear(assembly, setup, config, clock)
+            elif mesh is not None:
                 with prover_mesh(mesh):
                     proof = _prove_impl(assembly, setup, config, clock)
             else:

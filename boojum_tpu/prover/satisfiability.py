@@ -8,11 +8,21 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..cs.field_like import ScalarOps
+from ..cs.field_like import BBScalarOps, ScalarOps
 from ..cs.gates.base import RowView, TermsCollector
 
 
+def _scalar_ops_for(assembly):
+    """The scalar ops context matching the field the assembly was
+    synthesized over (ISSUE 20): gate evaluators must reduce mod the same
+    prime the witness resolver used or every row looks unsatisfied."""
+    if getattr(assembly, "field", "goldilocks") == "babybear":
+        return BBScalarOps
+    return ScalarOps
+
+
 def check_if_satisfied(assembly, verbose: bool = False) -> bool:
+    ops = _scalar_ops_for(assembly)
     n = assembly.trace_len
     geometry = assembly.geometry
     copy_vals = assembly.copy_cols_values
@@ -33,7 +43,7 @@ def check_if_satisfied(assembly, verbose: bool = False) -> bool:
                 lambda i, consts=consts: consts[i] if i < len(consts) else 0,
             )
             dst = TermsCollector()
-            gate.evaluate(ScalarOps, row_view, dst)
+            gate.evaluate(ops, row_view, dst)
             for ti, term in enumerate(dst.terms):
                 if term != 0:
                     if verbose:

@@ -60,20 +60,20 @@ def non_residues_for_copy_permutation(num_cols: int) -> list[int]:
     return out
 
 
-def compute_sigma_values(
-    copy_placement: np.ndarray, trace_len: int, non_residues=None
-):
-    """Vectorized permutation-polynomial construction.
+def non_residues_for_copy_permutation_bb(num_cols: int) -> list[int]:
+    """The BabyBear k_col = 31^col family (31 generates the full
+    multiplicative group, so the cosets are distinct up to huge widths)."""
+    from ..field import babybear as bb
 
-    copy_placement: (C, n) int64 of place ids (-1 vacant). Cells holding the
-    same variable form a cycle; sigma maps each cell to the next one in its
-    cycle (vacant cells are fixed points). Returns (C, n) uint64 of
-    sigma_col(w^row) = k_{col'} * w^{row'}.
+    out = [1]
+    for _ in range(1, num_cols):
+        out.append(bb.mul_s(out[-1], 31))
+    return out
 
-    non_residues: per-column coset representatives k_col; defaults to this
-    framework's g^col family (the reference-dialect prover passes the
-    reference's small-QNR family instead).
-    """
+
+def _sigma_cells(copy_placement: np.ndarray, trace_len: int) -> np.ndarray:
+    """Field-independent half of the permutation-poly construction: the
+    flat cell -> next-cell-in-cycle map (vacant cells fixed points)."""
     C, n = copy_placement.shape
     assert n == trace_len
     pl = copy_placement.reshape(-1)
@@ -95,6 +95,25 @@ def compute_sigma_values(
     # vacant cells: identity
     vacant = pl < 0
     sigma_cell[vacant] = np.nonzero(vacant)[0]
+    return sigma_cell
+
+
+def compute_sigma_values(
+    copy_placement: np.ndarray, trace_len: int, non_residues=None
+):
+    """Vectorized permutation-polynomial construction.
+
+    copy_placement: (C, n) int64 of place ids (-1 vacant). Cells holding the
+    same variable form a cycle; sigma maps each cell to the next one in its
+    cycle (vacant cells are fixed points). Returns (C, n) uint64 of
+    sigma_col(w^row) = k_{col'} * w^{row'}.
+
+    non_residues: per-column coset representatives k_col; defaults to this
+    framework's g^col family (the reference-dialect prover passes the
+    reference's small-QNR family instead).
+    """
+    C, n = copy_placement.shape
+    sigma_cell = _sigma_cells(copy_placement, trace_len)
     # encode: cell -> k_col * w^row
     omega = gl.omega(n.bit_length() - 1)
     w_pows = np.zeros(n, dtype=np.uint64)
@@ -108,6 +127,26 @@ def compute_sigma_values(
     tgt_col = (sigma_cell // n).astype(np.int64)
     tgt_row = (sigma_cell % n).astype(np.int64)
     vals = _np_mod_mul(ks[tgt_col], w_pows[tgt_row])
+    return vals.reshape(C, n)
+
+
+def compute_sigma_values_bb(
+    copy_placement: np.ndarray, trace_len: int, non_residues=None
+):
+    """BabyBear twin of compute_sigma_values: same vectorized cycle walk,
+    encode over p = 2^31 - 2^27 + 1 with the 31^col non-residue family.
+    Returns (C, n) uint32."""
+    from ..field import babybear as bb
+
+    C, n = copy_placement.shape
+    sigma_cell = _sigma_cells(copy_placement, trace_len)
+    w_pows = bb.powers_np(bb.omega(n.bit_length() - 1), n)
+    if non_residues is None:
+        non_residues = non_residues_for_copy_permutation_bb(C)
+    ks = np.array([int(k) for k in non_residues], dtype=np.uint32)
+    tgt_col = (sigma_cell // n).astype(np.int64)
+    tgt_row = (sigma_cell % n).astype(np.int64)
+    vals = bb.mul_np(ks[tgt_col], w_pows[tgt_row])
     return vals.reshape(C, n)
 
 
@@ -297,6 +336,11 @@ def generate_setup(assembly, config) -> SetupData:
     full_placement = np.concatenate(
         [assembly.copy_placement, assembly.lookup_placement], axis=0
     )
+    if getattr(assembly, "field", "goldilocks") == "babybear":
+        return _generate_setup_babybear(
+            assembly, config, full_placement, selector_paths,
+            quotient_degree,
+        )
     sigma = compute_sigma_values(full_placement, n)
     consts = build_constant_columns(assembly, selector_paths)
     if assembly.lookups_enabled:
@@ -363,4 +407,97 @@ def generate_setup(assembly, config) -> SetupData:
         setup_tree=tree,
         selector_paths=selector_paths,
         non_residues=non_residues_for_copy_permutation(sigma.shape[0]),
+    )
+
+
+_BB_TRANSCRIPTS = {
+    "poseidon2": "poseidon2_babybear",
+    "blake2s": "blake2s_babybear",
+}
+
+
+def _generate_setup_babybear(
+    assembly, config, full_placement, selector_paths, quotient_degree
+):
+    """The BabyBear setup leg (ISSUE 20): u32 sigma/constant/table columns,
+    HOST numpy monomials + coset-31 LDE, and a paired-leaf Poseidon2-BB
+    Merkle commit — the same oracle layout the full prover's witness
+    commits use, shared verbatim by the device and numpy prover backends
+    (setup-cap parity is by construction)."""
+    from ..field import babybear as bb
+    from ..hashes import poseidon2_bb as p2bb
+    from ..ntt import bb_ntt
+    from .bb_kernels import BBMerkleTree
+
+    n = assembly.trace_len
+    L = config.fri_lde_factor
+    half = (n * L) // 2
+    non_residues = non_residues_for_copy_permutation_bb(
+        full_placement.shape[0]
+    )
+    sigma = compute_sigma_values_bb(full_placement, n, non_residues)
+    consts = build_constant_columns(assembly, selector_paths).astype(
+        np.uint32
+    )
+    if assembly.lookups_enabled:
+        assert assembly.lookup_table_id_col is not None, (
+            "babybear backend supports specialized lookup columns only"
+        )
+        consts = np.concatenate(
+            [consts, assembly.lookup_table_id_col[None, :].astype(np.uint32)],
+            axis=0,
+        )
+        table_cols = assembly.stacked_table_columns(
+            assembly.lookup_params.width
+        ).astype(np.uint32)
+        setup_cols = np.concatenate([sigma, consts, table_cols], axis=0)
+    else:
+        setup_cols = np.concatenate([sigma, consts], axis=0)
+    monomials = bb_ntt.ntt_np(setup_cols, inverse=True)
+    lde = bb_ntt.lde_np(monomials, L, 31)
+    paired = np.concatenate([lde[:, :half], lde[:, half:]], axis=0)
+    digests = p2bb.leaf_hash_bb_np(paired.T)
+    layers = [digests]
+    while layers[-1].shape[0] > config.merkle_tree_cap_size:
+        cur = layers[-1]
+        layers.append(p2bb.node_hash_bb_np(cur[0::2], cur[1::2]))
+    tree = BBMerkleTree(layers, config.merkle_tree_cap_size)
+    transcript = getattr(config, "transcript", "poseidon2")
+    transcript = _BB_TRANSCRIPTS.get(transcript, transcript)
+    assert transcript.endswith("babybear"), (
+        f"transcript {transcript} has no babybear instantiation"
+    )
+    vk = VerificationKey(
+        geometry=assembly.geometry,
+        trace_len=n,
+        fri_lde_factor=L,
+        quotient_degree=quotient_degree,
+        transcript=transcript,
+        cap_size=config.merkle_tree_cap_size,
+        num_queries=config.num_queries,
+        pow_bits=config.pow_bits,
+        fri_final_degree=config.fri_final_degree,
+        gate_names=[g.name for g in assembly.gates],
+        selector_paths=selector_paths,
+        public_input_locations=[
+            (c, r) for (c, r, _v) in assembly.public_inputs
+        ],
+        setup_merkle_cap=tree.get_cap(),
+        num_copy_cols=sigma.shape[0],
+        num_wit_cols=assembly.wit_placement.shape[0],
+        lookup_params=(
+            assembly.lookup_params if assembly.lookups_enabled else None
+        ),
+        num_lookup_tables=len(assembly.lookup_tables),
+        fri_folding_schedule=getattr(config, "fri_folding_schedule", None),
+    )
+    return SetupData(
+        vk=vk,
+        sigma_cols=sigma,
+        constant_cols=consts,
+        setup_monomials=monomials,
+        setup_lde=lde,
+        setup_tree=tree,
+        selector_paths=selector_paths,
+        non_residues=non_residues,
     )

@@ -1,0 +1,623 @@
+"""BabyBear full-prover stage math (ISSUE 20): the plane-free twins of
+prover/stages.py for the REAL PLONKish pipeline — stage-2 grand product and
+partial products, lookup sum polynomials, the fused gate/copy-permutation/
+lookup quotient sweep, and the DEEP accumulation — all in GF(p^4) over bare
+u32 lanes.
+
+Every computation here is written ONCE as a core parameterized over a tiny
+`lib` namespace (base/ext field ops + the field-like gate-ops class) and
+instantiated twice:
+
+  - DEVICE: jitted `_bb` kernels over `babybear` jnp ops + `BBArrayOps`
+    (the dispatch the cost ledger attributes via the `_bb` name suffix);
+  - NUMPY:  the same core over `*_np` twins + `BBNpArrayOps` for the
+    reference backend (compat/prove_reference_bb.py).
+
+Both backends therefore consume gate terms — and alpha powers — in exactly
+the same order; arithmetic is exact mod p on both sides, so proof parity is
+by construction and any divergence localizes to one kernel twin.
+
+No `field/limbs.py` import anywhere on this path (the plane-free claim,
+`limb.splits == 0`, is structural).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..field import babybear as bb
+from ..cs.field_like import BBArrayOps, BBNpArrayOps
+from ..cs.gates.base import TermsCollector
+from ..utils import metrics as _metrics
+from . import bb_kernels as K
+
+
+class _DevLib:
+    """jnp instantiation: bb device ops + BBArrayOps."""
+
+    ops = BBArrayOps
+    add = staticmethod(bb.add)
+    sub = staticmethod(bb.sub)
+    mul = staticmethod(bb.mul)
+    ext_add = staticmethod(bb.ext_add)
+    ext_sub = staticmethod(bb.ext_sub)
+    ext_mul = staticmethod(bb.ext_mul)
+    ext_inv = staticmethod(bb.ext_inv)
+    ext_prefix_product = staticmethod(bb.ext_prefix_product)
+
+    @staticmethod
+    def const(v: int):
+        return jnp.uint32(int(v) % bb.P)
+
+    @staticmethod
+    def ones_like(x):
+        return jnp.ones_like(x)
+
+    @staticmethod
+    def stack(xs):
+        return jnp.stack(xs)
+
+    @staticmethod
+    def broadcast_to(x, shape):
+        return jnp.broadcast_to(x, shape)
+
+
+class _NpLib:
+    """numpy instantiation: bb host twins + BBNpArrayOps."""
+
+    ops = BBNpArrayOps
+    add = staticmethod(bb.add_np)
+    sub = staticmethod(bb.sub_np)
+    mul = staticmethod(bb.mul_np)
+    ext_add = staticmethod(bb.ext_add_np)
+
+    @staticmethod
+    def ext_sub(a, b):
+        return tuple(bb.sub_np(x, y) for x, y in zip(a, b))
+
+    ext_mul = staticmethod(bb.ext_mul_np)
+    ext_inv = staticmethod(bb.ext_inv_np)
+    ext_prefix_product = staticmethod(bb.ext_prefix_product_np)
+
+    @staticmethod
+    def const(v: int):
+        return np.uint32(int(v) % bb.P)
+
+    @staticmethod
+    def ones_like(x):
+        return np.ones_like(x)
+
+    @staticmethod
+    def stack(xs):
+        return np.stack(xs)
+
+    @staticmethod
+    def broadcast_to(x, shape):
+        return np.broadcast_to(x, shape)
+
+
+def _ext4(stacked):
+    """(4, ...) stacked -> 4-tuple of base arrays/scalars."""
+    return tuple(stacked[k] for k in range(4))
+
+
+def ext_powers_table_bb(e, count: int) -> np.ndarray:
+    """(4, count) u32 host table of ext powers 1, e, e^2, ... (the BB
+    AlphaPows supply: built on host, consumed as an array argument so new
+    challenges never retrace the sweep)."""
+    out = np.zeros((4, max(count, 1)), dtype=np.uint32)
+    cur = bb.ONE_S
+    for i in range(max(count, 1)):
+        for k in range(4):
+            out[k, i] = cur[k]
+        cur = bb.ext_mul_s(cur, tuple(int(c) for c in e))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Shared cores (lib-parameterized; see module docstring)
+# ---------------------------------------------------------------------------
+
+
+def _cp_num_den(lib, wcol, scol, kx, beta, gamma):
+    """The copy-permutation rational's numerator (w + β·k·x + γ) and
+    denominator (w + β·σ + γ) as ext 4-tuples over base arrays."""
+    num = (
+        lib.add(lib.add(wcol, lib.mul(kx, beta[0])), gamma[0]),
+        lib.add(lib.mul(kx, beta[1]), gamma[1]),
+        lib.add(lib.mul(kx, beta[2]), gamma[2]),
+        lib.add(lib.mul(kx, beta[3]), gamma[3]),
+    )
+    den = (
+        lib.add(lib.add(wcol, lib.mul(scol, beta[0])), gamma[0]),
+        lib.add(lib.mul(scol, beta[1]), gamma[1]),
+        lib.add(lib.mul(scol, beta[2]), gamma[2]),
+        lib.add(lib.mul(scol, beta[3]), gamma[3]),
+    )
+    return num, den
+
+
+def _stage2_core(lib, copy_vals, sigma_vals, ks, xs, beta, gamma, chunks):
+    """z and partial products over H (stages.compute_copy_permutation_stage2
+    twin): per-chunk num/den products, ONE stacked ext inversion, exclusive
+    ext prefix product, cumulative partials. Returns a (1 + num_partials,
+    4, n) stack [z; p_0; ...]."""
+    n = copy_vals.shape[-1]
+    num_ps, den_ps = [], []
+    for chunk in chunks:
+        num_p = den_p = None
+        for col in chunk:
+            kx = lib.mul(xs, lib.const(int(ks[col])))
+            num, den = _cp_num_den(
+                lib, copy_vals[col], sigma_vals[col], kx, beta, gamma
+            )
+            num_p = num if num_p is None else lib.ext_mul(num_p, num)
+            den_p = den if den_p is None else lib.ext_mul(den_p, den)
+        num_ps.append(num_p)
+        den_ps.append(den_p)
+    Kc = len(chunks)
+    den_stack = tuple(lib.stack([d[k] for d in den_ps]) for k in range(4))
+    den_inv = lib.ext_inv(den_stack)
+    ratios = [
+        lib.ext_mul(num_ps[j], tuple(den_inv[k][j] for k in range(4)))
+        for j in range(Kc)
+    ]
+    full = ratios[0]
+    for j in range(1, Kc):
+        full = lib.ext_mul(full, ratios[j])
+    incl = lib.ext_prefix_product(full)
+    one = lib.ones_like(incl[0][..., :1])
+    zero = lib.mul(one, lib.const(0))
+    cat = jnp.concatenate if lib is _DevLib else np.concatenate
+    z = tuple(
+        cat([one if k == 0 else zero, incl[k][..., :-1]], axis=-1)
+        for k in range(4)
+    )
+    rows = [lib.stack(z)]
+    acc = z
+    for j in range(Kc - 1):
+        acc = lib.ext_mul(acc, ratios[j])
+        rows.append(lib.stack(acc))
+    return lib.stack(rows)
+
+
+def _ext_powers_seq(lib, g, count: int):
+    """[1, g, ..., g^(count-1)] as ext 4-tuples of scalars (host-loop of
+    traced/np ext muls — the gamma-power ladder of the lookup aggregator)."""
+    one = lib.const(1)
+    zero = lib.const(0)
+    pows = [(one, zero, zero, zero)]
+    for _ in range(count - 1):
+        pows.append(lib.ext_mul(pows[-1], g))
+    return pows
+
+
+def _aggregate_lookup(lib, cols, tid_col, gpow, beta, shape):
+    """Σ_j γ^j·col_j (+ γ^w·table_id) + β -> ext 4-tuple over base arrays
+    (stages.aggregate_lookup_columns twin)."""
+    acc = tuple(lib.broadcast_to(beta[k], shape) for k in range(4))
+    seq = list(cols) + ([tid_col] if tid_col is not None else [])
+    for j, col in enumerate(seq):
+        acc = tuple(
+            lib.add(acc[k], lib.mul(col, gpow[j][k])) for k in range(4)
+        )
+    return acc
+
+
+def _lookup_polys_core(
+    lib, lookup_cols, tid_col, table_cols, mults, lkb, lkg, R, width
+):
+    """A_i and B over H (stages.compute_lookup_polys twin, SPECIALIZED
+    columns mode): (R+1, 4, n) stack [A_0..A_{R-1}; B]."""
+    shape = tid_col.shape
+    gpow = _ext_powers_seq(lib, lkg, width + 1)
+    dens = []
+    for i in range(R):
+        cols = [lookup_cols[i * width + j] for j in range(width)]
+        dens.append(_aggregate_lookup(lib, cols, tid_col, gpow, lkb, shape))
+    dens.append(
+        _aggregate_lookup(
+            lib,
+            [table_cols[j] for j in range(width)],
+            table_cols[width],
+            gpow,
+            lkb,
+            shape,
+        )
+    )
+    den_stack = tuple(lib.stack([d[k] for d in dens]) for k in range(4))
+    inv = lib.ext_inv(den_stack)
+    rows = [lib.stack([inv[k][i] for k in range(4)]) for i in range(R)]
+    rows.append(lib.stack([lib.mul(inv[k][R], mults) for k in range(4)]))
+    return lib.stack(rows)
+
+
+class _ApCursor:
+    """Sequential ext-challenge-power supply over a (4, T) table — the BB
+    AlphaPows: over-consumption is a prover term-count bug, fail loudly."""
+
+    def __init__(self, table, count: int):
+        self.table = table
+        self.count = count
+        self.cursor = 0
+
+    def take1(self):
+        assert self.cursor < self.count, "BB alpha powers over-consumed"
+        t = self.cursor
+        self.cursor += 1
+        return tuple(self.table[k][t] for k in range(4))
+
+
+def _acc_base_term(lib, acc, term_base, ch):
+    """acc += ch * term for a base-field term array, ext 4-tuple ch."""
+    t = tuple(lib.mul(term_base, ch[k]) for k in range(4))
+    if acc is None:
+        return t
+    return lib.ext_add(acc, t)
+
+
+def _acc_ext_term(lib, acc, term_ext, ch):
+    t = lib.ext_mul(term_ext, ch)
+    if acc is None:
+        return t
+    return lib.ext_add(acc, t)
+
+
+def _selector_poly(lib, const_cols, path):
+    """Product over path bits of c_b or (1 - c_b)."""
+    sel = None
+    for b, bit in enumerate(path):
+        col = const_cols[b]
+        f = (
+            col
+            if bit
+            else lib.sub(lib.mul(lib.ones_like(col), lib.const(1)), col)
+        )
+        sel = f if sel is None else lib.mul(sel, f)
+    return sel
+
+
+class _RowViewBB:
+    """stages.LdeRowView twin over the flattened BB sweep stacks."""
+
+    def __init__(self, copy_v, wit_v, const_v, vo, wo, ko):
+        self._c, self._w, self._k = copy_v, wit_v, const_v
+        self._vo, self._wo, self._ko = vo, wo, ko
+
+    def v(self, i):
+        return self._c[self._vo + i]
+
+    def w(self, i):
+        return self._w[self._wo + i]
+
+    def c(self, i):
+        return self._k[self._ko + i]
+
+
+def _sweep_core(
+    lib, gates, selector_paths, geometry, lk_ctx, non_residues,
+    wit_v, setup_v, s2_v, zs_v, xs, l0, zh_inv,
+    apows_tbl, total_alpha_terms, beta, gamma, lkb, lkg,
+):
+    """The fused quotient terms over the (rate-Q) sweep domain: gate sweep
+    + copy-permutation terms + lookup terms, divided by Z_H. Term (and
+    therefore alpha-power) order is the GL prover's: gates -> cp -> lookup
+    (prover._u64_sweep_core). Returns the (4, Q*n) ext accumulator."""
+    (lookups, R_args, width, num_partials, chunks, Cg, Ct, W, Kc, M) = lk_ctx
+    ap = _ApCursor(apows_tbl, total_alpha_terms)
+    copy_v = wit_v[:Ct]
+    gate_wit_v = wit_v[Ct : Ct + W] if W else None
+    sigma_v = setup_v[:Ct]
+    const_v = setup_v[Ct : Ct + Kc]
+    table_v = setup_v[Ct + Kc :]
+    z_v = _ext4(s2_v[0:4])
+    z_shift_v = _ext4(zs_v)
+    partial_v = [
+        _ext4(s2_v[4 + 4 * j : 8 + 4 * j]) for j in range(num_partials)
+    ]
+    acc = None
+    # --- gate terms (selector-tree masked evaluation) ---
+    for gid, gate in enumerate(gates):
+        if gate.num_terms == 0:
+            continue
+        sel = _selector_poly(lib, const_v, selector_paths[gid])
+        reps = gate.num_repetitions(geometry)
+        gate_acc = None
+        for inst in range(reps):
+            row = _RowViewBB(
+                copy_v[:Cg], gate_wit_v, const_v,
+                inst * gate.principal_width,
+                inst * gate.witness_width,
+                len(selector_paths[gid]),
+            )
+            dst = TermsCollector()
+            gate.evaluate(lib.ops, row, dst)
+            assert len(dst.terms) == gate.num_terms, gate.name
+            for term in dst.terms:
+                gate_acc = _acc_base_term(lib, gate_acc, term, ap.take1())
+        if gate_acc is not None:
+            if sel is not None:
+                gate_acc = tuple(lib.mul(c, sel) for c in gate_acc)
+            acc = gate_acc if acc is None else lib.ext_add(acc, gate_acc)
+    # --- copy-permutation terms ---
+    zm1 = (lib.sub(z_v[0], lib.ones_like(z_v[0])),) + z_v[1:]
+    t0 = tuple(lib.mul(c, l0) for c in zm1)
+    acc = _acc_ext_term(lib, acc, t0, ap.take1())
+    lhs_seq = list(partial_v) + [z_shift_v]
+    rhs_seq = [z_v] + list(partial_v)
+    for j, chunk in enumerate(chunks):
+        num_p = den_p = None
+        for col in chunk:
+            kx = lib.mul(xs, lib.const(int(non_residues[col])))
+            num, den = _cp_num_den(
+                lib, copy_v[col], sigma_v[col], kx, beta, gamma
+            )
+            num_p = num if num_p is None else lib.ext_mul(num_p, num)
+            den_p = den if den_p is None else lib.ext_mul(den_p, den)
+        term = lib.ext_sub(
+            lib.ext_mul(lhs_seq[j], den_p), lib.ext_mul(rhs_seq[j], num_p)
+        )
+        acc = _acc_ext_term(lib, acc, term, ap.take1())
+    # --- lookup terms (specialized columns mode) ---
+    if lookups:
+        ab_off = 4 + 4 * num_partials
+        a_v = [
+            _ext4(s2_v[ab_off + 4 * i : ab_off + 4 * i + 4])
+            for i in range(R_args)
+        ]
+        b_v = _ext4(s2_v[ab_off + 4 * R_args : ab_off + 4 * R_args + 4])
+        gpow = _ext_powers_seq(lib, lkg, width + 1)
+        tid_v = const_v[Kc - 1]
+        for i in range(R_args):
+            cols = [copy_v[Cg + i * width + j] for j in range(width)]
+            den = _aggregate_lookup(lib, cols, tid_v, gpow, lkb, xs.shape)
+            term = lib.ext_mul(a_v[i], den)
+            term = (lib.sub(term[0], lib.ones_like(term[0])),) + term[1:]
+            acc = _acc_ext_term(lib, acc, term, ap.take1())
+        t_den = _aggregate_lookup(
+            lib,
+            [table_v[j] for j in range(width)],
+            table_v[width],
+            gpow,
+            lkb,
+            xs.shape,
+        )
+        term = lib.ext_mul(b_v, t_den)
+        term = (lib.sub(term[0], wit_v[Ct + W]),) + term[1:]
+        acc = _acc_ext_term(lib, acc, term, ap.take1())
+    assert ap.cursor == total_alpha_terms, (ap.cursor, total_alpha_terms)
+    return tuple(lib.mul(c, zh_inv) for c in acc)
+
+
+def _modsum0(lib, a):
+    """Exact mod-p sum along axis 0 (log-depth fold of lib.add)."""
+    while a.shape[0] > 1:
+        half = a.shape[0] // 2
+        rest = a[2 * half :]
+        a = lib.add(a[0:half], a[half : 2 * half])
+        if rest.shape[0]:
+            cat = jnp.concatenate if lib is _DevLib else np.concatenate
+            a = cat([a, rest], axis=0)
+    return a[0]
+
+
+def _base_minus_ext(lib, base_arr, e):
+    """(base - e) as an ext 4-tuple (bb_kernels twin over lib)."""
+    shape = base_arr.shape
+    return (
+        lib.sub(base_arr, lib.broadcast_to(e[0], shape)),
+        lib.broadcast_to(lib.sub(lib.const(0), e[1]), shape),
+        lib.broadcast_to(lib.sub(lib.const(0), e[2]), shape),
+        lib.broadcast_to(lib.sub(lib.const(0), e[3]), shape),
+    )
+
+
+def _deep_core(
+    lib, all_lde, zw_cols, lk_cols, pi_cols, xs,
+    z4, zw4, ch_tbl, at_z_const, y_zw, y_lk, pi_vals, pi_inv,
+    num_lk, num_pi,
+):
+    """The BB DEEP codeword (4, N) — challenge-power order mirrors the GL
+    prover exactly: all committed base columns at z (grouped: Σ ch_i·f_i
+    minus the host-precomputed Σ ch_i·v_i constant), then the z-poly's 4
+    base columns at z·omega, then each lookup A_i/B ext pair at 0, then the
+    public-input opens."""
+    B = all_lde.shape[0]
+    # main at-z group: num_k = Σ_i ch_i[k]·f_i − const_k, ÷ (x − z)
+    num = tuple(
+        lib.sub(
+            _modsum0(lib, lib.mul(all_lde, ch_tbl[k][:B][:, None])),
+            lib.broadcast_to(at_z_const[k], xs.shape),
+        )
+        for k in range(4)
+    )
+    inv_xz = lib.ext_inv(_base_minus_ext(lib, xs, z4))
+    h = lib.ext_mul(num, inv_xz)
+    # z-poly base columns at z*omega (one challenge power per base column)
+    inv_xzw = lib.ext_inv(_base_minus_ext(lib, xs, zw4))
+    t = B
+    for i in range(4):
+        ch = tuple(ch_tbl[k][t] for k in range(4))
+        num_i = _base_minus_ext(lib, zw_cols[i], _ext4(y_zw[:, i]))
+        h = lib.ext_add(h, lib.ext_mul(lib.ext_mul(num_i, inv_xzw), ch))
+        t += 1
+    # lookup A_i/B at 0: ext numerator over the 4 base columns, ÷ x
+    if num_lk:
+        inv_x = lib.ext_inv(
+            (xs, lib.mul(xs, lib.const(0)),
+             lib.mul(xs, lib.const(0)), lib.mul(xs, lib.const(0)))
+        )
+        for i in range(num_lk):
+            ch = tuple(ch_tbl[k][t] for k in range(4))
+            num_i = tuple(
+                lib.sub(
+                    lk_cols[4 * i + k],
+                    lib.broadcast_to(y_lk[i, k], xs.shape),
+                )
+                for k in range(4)
+            )
+            h = lib.ext_add(h, lib.ext_mul(lib.ext_mul(num_i, inv_x), ch))
+            t += 1
+    # public inputs: (w_col(x) − value) / (x − ω^row), base × ext ch
+    for k_pi in range(num_pi):
+        ch = tuple(ch_tbl[k][t] for k in range(4))
+        num_b = lib.mul(
+            lib.sub(pi_cols[k_pi], lib.broadcast_to(pi_vals[k_pi], xs.shape)),
+            pi_inv[k_pi],
+        )
+        h = lib.ext_add(h, tuple(lib.mul(num_b, ch[k]) for k in range(4)))
+        t += 1
+    return lib.stack(h)
+
+
+# ---------------------------------------------------------------------------
+# Device kernels (the full-prover `_bb` ledger set)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnums=(2, 6))
+def stage2_z_partials_bb(copy_vals, sigma_vals, ks, xs, beta, gamma, chunks):
+    """(1 + num_partials, 4, n) device stack [z; partials...]. `ks` (the
+    non-residues) and `chunks` are static tuples."""
+    return _stage2_core(
+        _DevLib, copy_vals, sigma_vals, ks, xs,
+        _ext4(beta), _ext4(gamma), chunks,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(6, 7))
+def lookup_polys_bb(
+    lookup_cols, tid_col, table_cols, mults, lkb, lkg, R: int, width: int
+):
+    """(R+1, 4, n) device stack [A_0..A_{R-1}; B]."""
+    return _lookup_polys_core(
+        _DevLib, lookup_cols, tid_col, table_cols, mults,
+        _ext4(lkb), _ext4(lkg), R, width,
+    )
+
+
+def stage2_z_partials_np(copy_vals, sigma_vals, ks, xs, beta, gamma, chunks):
+    """Numpy twin of stage2_z_partials_bb (reference backend)."""
+    return np.asarray(
+        _stage2_core(
+            _NpLib, copy_vals, sigma_vals, ks, xs,
+            _ext4(np.asarray(beta, dtype=np.uint32)),
+            _ext4(np.asarray(gamma, dtype=np.uint32)), chunks,
+        )
+    )
+
+
+def lookup_polys_np(
+    lookup_cols, tid_col, table_cols, mults, lkb, lkg, R: int, width: int
+):
+    """Numpy twin of lookup_polys_bb (reference backend)."""
+    return np.asarray(
+        _lookup_polys_core(
+            _NpLib, lookup_cols, tid_col, table_cols, mults,
+            _ext4(np.asarray(lkb, dtype=np.uint32)),
+            _ext4(np.asarray(lkg, dtype=np.uint32)), R, width,
+        )
+    )
+
+
+def build_full_sweep_bb(gates, selector_paths, geometry, lk_ctx, non_residues):
+    """Assembly-cached jitted quotient-terms graph over the whole rate-Q
+    sweep domain (the BB twin of prover._coset_sweep_fn at 2^10-scale: one
+    graph over Q·n points instead of Q per-coset dispatches)."""
+    _metrics.count("gate_sweep.bb_builds")
+    gates = tuple(gates)
+    selector_paths = tuple(tuple(p) for p in selector_paths)
+    non_residues = tuple(int(k) for k in non_residues)
+    total = lk_ctx[-1]
+    lk_core = lk_ctx[:-1]
+
+    @jax.jit
+    def fn(wit_v, setup_v, s2_v, zs_v, xs, l0, zh_inv, apows,
+           beta, gamma, lkb, lkg):
+        return jnp.stack(
+            _sweep_core(
+                _DevLib, gates, selector_paths, geometry, lk_core,
+                non_residues, wit_v, setup_v, s2_v, zs_v, xs, l0, zh_inv,
+                _ext4(apows), total, _ext4(beta), _ext4(gamma),
+                _ext4(lkb), _ext4(lkg),
+            )
+        )
+
+    return fn
+
+
+def full_sweep_np(
+    gates, selector_paths, geometry, lk_ctx, non_residues,
+    wit_v, setup_v, s2_v, zs_v, xs, l0, zh_inv, apows,
+    beta, gamma, lkb, lkg,
+):
+    """The numpy twin of build_full_sweep_bb's graph (same cores)."""
+    total = lk_ctx[-1]
+    return np.stack(
+        _sweep_core(
+            _NpLib, tuple(gates), tuple(tuple(p) for p in selector_paths),
+            geometry, lk_ctx[:-1], tuple(int(k) for k in non_residues),
+            wit_v, setup_v, s2_v, zs_v, xs, l0, zh_inv,
+            _ext4(apows), total, _ext4(beta), _ext4(gamma),
+            _ext4(lkb), _ext4(lkg),
+        )
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(13, 14))
+def deep_full_bb(
+    all_lde, zw_cols, lk_cols, pi_cols, xs, z4, zw4, ch_tbl,
+    at_z_const, y_zw, y_lk, pi_vals, pi_inv, num_lk: int, num_pi: int,
+):
+    """The full-prover DEEP codeword (4, N), device."""
+    return _deep_core(
+        _DevLib, all_lde, zw_cols, lk_cols, pi_cols, xs,
+        _ext4(z4), _ext4(zw4), _ext4(ch_tbl), _ext4(at_z_const),
+        y_zw, y_lk, pi_vals, pi_inv, num_lk, num_pi,
+    )
+
+
+def deep_full_np(
+    all_lde, zw_cols, lk_cols, pi_cols, xs, z4, zw4, ch_tbl,
+    at_z_const, y_zw, y_lk, pi_vals, pi_inv, num_lk: int, num_pi: int,
+):
+    return _deep_core(
+        _NpLib, all_lde, zw_cols, lk_cols, pi_cols, xs,
+        _ext4(z4), _ext4(zw4), _ext4(ch_tbl), _ext4(at_z_const),
+        y_zw, y_lk, pi_vals, pi_inv, num_lk, num_pi,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host domain tables (witness-independent, cached per domain shape)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def l0_lde_bb(log_n: int, rate: int, shift: int) -> np.ndarray:
+    """L_0(x) = (x^n − 1)/(n·(x − 1)) over the natural-order rate-`rate`
+    coset shift·<w_N> — the full-prover twin of prover._l0_brev."""
+    n = 1 << log_n
+    zh = bb.sub_np(
+        np.tile(
+            np.array(
+                [
+                    bb.mul_s(
+                        bb.pow_s(shift % bb.P, n),
+                        bb.pow_s(bb.omega(rate.bit_length() - 1), r),
+                    )
+                    for r in range(rate)
+                ],
+                dtype=np.uint32,
+            ),
+            n,
+        ),
+        np.uint32(1),
+    )
+    xs = K.domain_xs_bb(log_n, rate, shift)
+    xm1_inv = K._host_batch_inv(bb.sub_np(xs, np.uint32(1)))
+    return bb.mul_np(bb.mul_np(zh, np.uint32(bb.inv_s(n))), xm1_inv)

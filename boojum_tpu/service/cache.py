@@ -107,14 +107,52 @@ class DeviceCacheManager:
         (twiddles for rates L and Q, domain constants, FRI fold tables) —
         idempotent and enqueue-only, exactly the set the prover's round-0
         prefetch touches. Returns True when this call did the warming."""
+        from ..field.spec import active_field, is_babybear
+
         key = (
             bucket.log_n, bucket.lde_factor, bucket.quotient_degree,
             bucket.fri_final_degree, bucket.fri_schedule, bucket.lookups,
+            # field backend (ISSUE 20): a geometry warmed under goldilocks
+            # holds u64 twiddles — the same bucket under babybear needs
+            # its own u32 table set, so the field is part of the key
+            active_field(),
         )
         with self._lock:
             if key in self._warmed_geometries:
                 return False
             self._warmed_geometries.add(key)
+        if is_babybear():
+            # the babybear full prover (prover/prover_bb.py) consumes the
+            # plane-free u32 table set — bb_ntt twiddles/scale tables at
+            # trace size and both full-domain rates, the coset domain
+            # constants, and the FRI fold-challenge tables; warm exactly
+            # that set, nothing limb- or u64-shaped
+            from ..field import babybear as _bb
+            from ..ntt import bb_ntt as BN
+            from ..prover import bb_kernels as BK
+            from ..prover import stages_bb as SBB
+
+            shift = int(_bb.SPEC.multiplicative_generator)
+            log_L = bucket.lde_factor.bit_length() - 1
+            log_Q = bucket.quotient_degree.bit_length() - 1
+            for lg in (
+                bucket.log_n, bucket.log_n + log_L, bucket.log_n + log_Q,
+            ):
+                BN._twiddles(lg, False)
+                BN._twiddles(lg, True)
+            BN._lde_scale_table(bucket.log_n, bucket.lde_factor, shift)
+            BN._lde_scale_table(bucket.log_n, bucket.quotient_degree, shift)
+            BK.domain_xs_bb(bucket.log_n, bucket.lde_factor, shift)
+            BK.domain_xs_bb(bucket.log_n, bucket.quotient_degree, shift)
+            BK.zh_inv_bb(bucket.log_n, bucket.quotient_degree, shift)
+            SBB.l0_lde_bb(bucket.log_n, bucket.quotient_degree, shift)
+            log_full = bucket.log_n + log_L
+            num_rounds = (
+                bucket.trace_len // bucket.fri_final_degree
+            ).bit_length() - 1
+            if num_rounds >= 1:
+                BK.fri_fold_tables_bb(log_full, shift, num_rounds)
+            return True
         from ..prover.pallas_sweep import limb_resident_enabled
 
         if limb_resident_enabled():

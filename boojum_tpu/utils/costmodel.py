@@ -762,6 +762,22 @@ def stage_costs(
         if f > 0.0 and st["ici_bytes"] > 0.0:
             st["dcn_bytes"] = st["ici_bytes"] * f
             st["ici_bytes"] *= 1.0 - f
+    # Field scaling (ISSUE 20): the stage formulas above model 8-byte
+    # Goldilocks lanes; the BabyBear backend moves the SAME element
+    # counts at 4 bytes each, so every traffic term is exactly eb/8 of
+    # the Goldilocks sheet. Flops are left alone — the mod-p multiply
+    # width is a per-kernel concern the kernel sheet already prices.
+    try:
+        from ..field.spec import is_babybear
+
+        if is_babybear():
+            scale = BB_ELEM_BYTES / 8.0
+            for st in stages.values():
+                for key in ("hbm_bytes", "ici_bytes", "dcn_bytes"):
+                    if key in st:
+                        st[key] *= scale
+    except Exception:  # noqa: BLE001 — cost model must never fail a prove
+        pass
     return stages
 
 

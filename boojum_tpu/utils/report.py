@@ -2263,8 +2263,18 @@ def slo_summary(reports: list[dict]) -> dict:
     cost_lines = 0
     stage_eff: dict[str, list] = {}
     stage_regimes: dict[str, dict] = {}
+    # field-backend axis (ISSUE 20): which field each line proved under —
+    # bench lines stamp it top-level, report lines carry it in the cost
+    # record; a babybear deploy's wall/byte numbers are not comparable to
+    # goldilocks ones, so the summary names the split
+    field_lines: dict[str, int] = {}
     for r in reports:
         c = r.get("cost")
+        fld = r.get("field") or (
+            c.get("field") if isinstance(c, dict) else None
+        )
+        if isinstance(fld, str):
+            field_lines[fld] = field_lines.get(fld, 0) + 1
         if not isinstance(c, dict):
             continue
         cost_lines += 1
@@ -2293,6 +2303,8 @@ def slo_summary(reports: list[dict]) -> dict:
         # which representation served: lines whose kernels dispatched
         # limb-RESIDENT (ISSUE 10) — BENCH/SLO deltas are attributable
         "limb_resident_lines": resident_lines,
+        # field backend per line (ISSUE 20), e.g. {"babybear": 3}
+        "fields": dict(sorted(field_lines.items())),
         "requests": len(reqs),
         "served": len(ok),
         "failed": len(reqs) - len(ok),
@@ -2343,6 +2355,13 @@ def render_slo(summary: dict) -> str:
         lines.append(
             f"  limb-resident {summary['limb_resident_lines']} lines "
             f"dispatched the resident kernel set"
+        )
+    if summary.get("fields"):
+        lines.append(
+            "  field backend "
+            + ", ".join(
+                f"{k}={v}" for k, v in summary["fields"].items()
+            )
         )
     if summary.get("placements"):
         lines.append(
@@ -2635,15 +2654,28 @@ _TREND_SKIP_STATUSES = ("no_prove", "warm_only")
 def _trend_identity(d: dict) -> str:
     """Compact machine/software identity of one artifact line (the
     `host` block bench.py / bench_micro.py stamp): micro lines from two
-    machines or jax versions must never share a gated series."""
+    machines or jax versions must never share a gated series. The field
+    backend is part of the identity too (ISSUE 20): a babybear point
+    moves half the bytes of the same goldilocks geometry, so mixing the
+    two in one gated series would mask (or fabricate) a regression."""
     h = d.get("host")
-    if not isinstance(h, dict):
-        return ""
-    parts = [
-        str(h.get(k))
-        for k in ("host_fp", "device_kind", "backend", "jax", "jaxlib")
-        if h.get(k) is not None
-    ]
+    parts = (
+        [
+            str(h.get(k))
+            for k in ("host_fp", "device_kind", "backend", "jax", "jaxlib")
+            if h.get(k) is not None
+        ]
+        if isinstance(h, dict)
+        else []
+    )
+    cost = d.get("cost")
+    fld = d.get("field") or (
+        cost.get("field") if isinstance(cost, dict) else None
+    )
+    if fld and fld != "goldilocks":
+        # goldilocks stays unsuffixed so the repo's pre-field history
+        # (and the ""-identity legacy-adoption pathway) keeps gating
+        parts.append(f"field={fld}")
     return "@".join(parts)
 
 

@@ -21,11 +21,13 @@
 # exports Chrome trace-event JSON, and the leg fails when the JSON is
 # invalid or the queue-wait span went missing.
 #
-# With --field, a smoke leg runs the BabyBear backend suite (ISSUE 19):
-# 2^10 e2e prove under BOOJUM_TPU_FIELD=babybear accepted by its own
-# verifier, deterministic Fiat-Shamir checkpoints across runs, zero
-# interior limb split/join conversions, and the `_bb` kernel set
-# enumerating/lowering + costing at half the Goldilocks HBM bytes.
+# With --field, a smoke leg runs the BabyBear backend suite (ISSUE 19)
+# plus the FULL-prover babybear parity suite (ISSUE 20): the 2^10
+# mini-STARK e2e under BOOJUM_TPU_FIELD=babybear, and the real
+# PLONKish prove() at 2^10 on the fma / xor4-lookup / poseidon-rf
+# circuits — device vs numpy proof bytes and checkpoint streams
+# bit-identical, zero limb conversions, quotient identity at z, the
+# half-HBM cost sheet, sha256-over-babybear rejected at synthesis.
 #
 # Exits nonzero when any requested leg fails. Knobs:
 #   CI_GATE_TIMEOUT_S     tier-1 budget in seconds (default 870, as in
@@ -148,7 +150,8 @@ if [ "$fieldleg" -eq 1 ]; then
     # stays unset here so the Goldilocks-default tests in the same file
     # see a clean process
     timeout -k 10 "$fd_timeout_s" env JAX_PLATFORMS=cpu \
-        python -m pytest tests/test_babybear.py -q \
+        python -m pytest tests/test_babybear.py \
+        tests/test_bb_full_prover.py -q \
         --continue-on-collection-errors \
         -p no:cacheprovider -p no:xdist -p no:randomly
     fd_rc=$?
